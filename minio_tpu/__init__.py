@@ -1,0 +1,19 @@
+"""minio_tpu: a TPU-native S3-compatible distributed object storage framework.
+
+A ground-up re-design of the capabilities of the reference implementation
+(MinIO, mounted at /root/reference): S3 API + IAM control plane in Python,
+with the byte-crunching data plane - GF(2^8) Reed-Solomon erasure coding,
+bitrot hashing - executed on TPU via JAX/Pallas, batched across requests.
+
+Layer map (mirrors SURVEY.md section 1):
+  server/       L6-L8: HTTP server, S3/Admin/STS routers, handlers
+  iam/          L5: signatures, IAM, policy
+  objectlayer/  L3: erasure object layer (objects/sets/zones), FS backend
+  codec/        L2: Erasure wrapper, bitrot framing   <- TPU hot path
+  ops/          L2: device kernels (RS codec, hashes)
+  storage/      L1: StorageAPI, local xl storage, storage REST
+  dsync/        L0: distributed quorum locks
+  parallel/     device mesh / sharding strategy
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,20 @@
+"""Test harness configuration.
+
+Tests run against the CPU backend with a virtual 8-device mesh so that all
+sharding / multi-chip codepaths (the analogue of the reference's in-process
+multi-disk test layouts, test-utils_test.go:185-202) are exercised without
+TPU hardware.  Must run before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
