@@ -1,0 +1,145 @@
+"""Fused erasure data-plane steps: one device pass per stripe batch.
+
+The reference's PutObject hot loop does RS-encode on CPU and then streams
+each shard through a HighwayHash writer (cmd/erasure-encode.go:73-109 +
+cmd/bitrot-streaming.go:38-88) - two passes over every byte.  Here both
+happen in a single fused XLA program per batch: parity generation and the
+per-shard bitrot digest read each byte from HBM once.
+
+These are the kernels the object layer batches concurrent requests into
+(the analogue of erasure-sets feeding per-disk queues).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf, hash as phash, rs
+
+
+@functools.partial(jax.jit, static_argnames=("parity_shards",))
+def encode_and_hash(data: jax.Array, parity_shards: int):
+    """Encode + bitrot-hash a batch of stripes in one fused pass.
+
+    data: (batch, k, shard_len) uint8, shard_len % 32 == 0.
+    Returns (shards, digests):
+      shards:  (batch, k+m, shard_len) uint8 - data rows then parity rows
+               (the write fan-out order of cmd/erasure-encode.go:39-54)
+      digests: (batch, k+m, 8) uint32 phash256 per shard block.
+    """
+    batch, k, shard_len = data.shape
+    m = parity_shards
+    if shard_len % 32:
+        raise ValueError("shard_len must be a multiple of 32 bytes")
+    matrix = gf.parity_matrix(k, m)
+
+    def one(stripe: jax.Array):
+        words = rs.bytes_to_words(stripe)  # (k, w)
+        parity = rs._encode_words(words, matrix)  # (m, w)
+        all_words = jnp.concatenate([words, parity], axis=0)
+        digests = jax.vmap(
+            lambda w: phash.phash256_words(w, shard_len)
+        )(all_words)
+        return rs.words_to_bytes(all_words), digests
+
+    return jax.vmap(one)(data)
+
+
+@functools.partial(jax.jit, static_argnames=("shard_len",))
+def verify_hashes(shards: jax.Array, digests: jax.Array, shard_len: int):
+    """Recompute phash256 for (batch, n, shard_len) shards, compare.
+
+    Returns (batch, n) bool - True where the shard is intact.  This is the
+    read-side bitrot verification (cmd/bitrot-streaming.go:130-146 /
+    xl-storage.go bitrotVerify) as one device pass over all shards.
+    """
+    def one(shard, want):
+        words = rs.bytes_to_words(shard)
+        got = phash.phash256_words(words, shard_len)
+        return jnp.all(got == want)
+
+    return jax.vmap(jax.vmap(one))(shards, digests)
+
+
+@functools.partial(jax.jit, static_argnames=("parity_shards", "reps"))
+def encode_throughput_probe(data: jax.Array, parity_shards: int, reps: int):
+    """Run `reps` dependent encode+hash passes inside ONE device program.
+
+    Benchmarking aid: chains iterations through a cheap XOR so XLA cannot
+    elide work, letting per-pass device time be measured without host
+    launch overhead (significant over the dev relay).  Returns a small
+    checksum array.
+    """
+    k = data.shape[1]
+
+    def body(carry, _):
+        shards, digests = encode_and_hash(carry, parity_shards)
+        nxt = shards[:, :k] ^ shards[:, k : k + 1]
+        return nxt, digests[0, 0, 0]
+
+    final, sums = jax.lax.scan(body, data, None, length=reps)
+    return final[0, 0, :8], sums
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("present", "data_shards", "parity_shards", "reps"),
+)
+def reconstruct_throughput_probe(
+    shards: jax.Array,
+    present: tuple[bool, ...],
+    data_shards: int,
+    parity_shards: int,
+    reps: int,
+):
+    """Chained batched static-pattern reconstructs (see encode probe)."""
+    from . import rs as _rs
+
+    def one(s):
+        return _rs._reconstruct_static_jit(
+            s, present, data_shards, parity_shards, False
+        )
+
+    def body(carry, _):
+        data = jax.vmap(one)(carry)
+        nxt = carry ^ jnp.concatenate(
+            [data, jnp.zeros_like(carry[:, data_shards:])], axis=1
+        )
+        return nxt, data[0, 0, 0]
+
+    final, sums = jax.lax.scan(body, shards, None, length=reps)
+    return final[0, 0, :8], sums
+
+
+def decode_and_verify(
+    shards: np.ndarray,
+    digests: np.ndarray,
+    data_shards: int,
+    parity_shards: int,
+):
+    """Read-path step: verify bitrot, reconstruct from intact shards.
+
+    Host-driven composition of verify_hashes + rs.reconstruct (the
+    erasure-decode.go:211-290 Decode semantics: verify every block read,
+    escalate to parity on failure, flag heal when any shard was bad).
+
+    Returns (data, ok_mask): data (k, shard_len) uint8, ok_mask (n,) bool.
+    Raises ValueError when fewer than k shards are intact (errXLReadQuorum
+    analogue).
+    """
+    n = data_shards + parity_shards
+    shard_len = shards.shape[-1]
+    ok = np.asarray(
+        verify_hashes(shards[None], digests[None], shard_len)[0]
+    )
+    if int(ok.sum()) < data_shards:
+        raise ValueError(
+            f"bitrot: only {int(ok.sum())}/{n} shards intact, "
+            f"need {data_shards}"
+        )
+    data = rs.reconstruct(shards, ok, data_shards, parity_shards)
+    return data, ok

@@ -106,17 +106,21 @@ def _matmul_words_dynamic(shards_words: jax.Array, matrix: jax.Array) -> jax.Arr
     survived: bits of the (traced) constants become XOR masks so a single
     compiled program serves every erasure pattern.
     """
-    s, _ = shards_words.shape
-    powers = jnp.stack(
-        [jnp.stack(_powers(shards_words[i])) for i in range(s)]
-    )  # (s, 8, w)
+    s, w = shards_words.shape
+    o = matrix.shape[0]
     m32 = matrix.astype(jnp.uint32)  # (o, s)
-    bits = (m32[:, :, None] >> jnp.arange(8, dtype=jnp.uint32)[None, None, :]) & 1
-    masks = (bits * jnp.uint32(0xFFFFFFFF))[:, :, :, None]  # (o, s, 8, 1)
-    terms = masks & powers[None]  # (o, s, 8, w)
-    acc = terms
-    for axis in (2, 1):
-        acc = _xor_reduce(acc, axis)
+    # Accumulate without materializing an (o, s, 8, w) intermediate: walk
+    # the xtime chain of each survivor lazily and fold masked terms into
+    # the (o, w) accumulator; stays HBM-friendly.
+    acc = jnp.zeros((o, w), dtype=jnp.uint32)
+    for i in range(s):
+        p = shards_words[i]
+        for b in range(8):
+            bit = (m32[:, i] >> np.uint32(b)) & np.uint32(1)  # (o,)
+            mask = (bit * jnp.uint32(0xFFFFFFFF))[:, None]
+            acc = acc ^ (mask & p[None, :])
+            if b != 7:
+                p = _xtime(p)
     return acc
 
 
@@ -182,12 +186,50 @@ def _reconstruct_jit(
     return jnp.where(keep, shards[: rebuilt.shape[0]], rebuilt)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("present", "data_shards", "parity_shards", "want_parity"),
+)
+def _reconstruct_static_jit(
+    shards: jax.Array,
+    present: tuple[bool, ...],
+    data_shards: int,
+    parity_shards: int,
+    want_parity: bool,
+) -> jax.Array:
+    """Static-pattern reconstruct: the erasure pattern is baked into the
+    compiled program, so the matrix XOR-select is pruned at trace time
+    (same cost profile as encode).
+
+    Production reads hit few distinct patterns - a dead drive yields the
+    same pattern for every object in the set, and heal sweeps
+    (cmd/erasure-lowlevel-heal.go) fix one pattern across the whole set -
+    so the per-pattern jit cache amortizes; `reconstruct` keeps the
+    dynamic-matrix fallback for pattern churn.
+    """
+    k, m = data_shards, parity_shards
+    idx = tuple(i for i, p in enumerate(present) if p)[:k]
+    rm = gf.reconstruction_matrix(k, m, idx)
+    words = bytes_to_words(shards)
+    survivors = jnp.stack([words[i] for i in idx])
+    data_words = _encode_words(survivors, rm)
+    if want_parity:
+        parity = _encode_words(data_words, gf.parity_matrix(k, m))
+        all_words = jnp.concatenate([data_words, parity], axis=0)
+    else:
+        all_words = data_words
+    rebuilt = words_to_bytes(all_words)
+    keep = np.asarray(present[: rebuilt.shape[0]])[:, None]
+    return jnp.where(keep, shards[: rebuilt.shape[0]], rebuilt)
+
+
 def reconstruct(
     shards: jax.Array | np.ndarray,
     present: "np.ndarray | list[bool]",
     data_shards: int,
     parity_shards: int,
     data_only: bool = True,
+    static_pattern: bool = True,
 ) -> jax.Array:
     """Device analogue of reedsolomon.ReconstructData / Reconstruct.
 
@@ -205,15 +247,24 @@ def reconstruct(
         raise ValueError(
             f"need {data_shards} shards, have {len(idx)}"
         )
-    rm = gf.reconstruction_matrix(data_shards, parity_shards, idx)
     shards = jnp.asarray(shards, dtype=jnp.uint8)
-    mask = jnp.asarray(present.astype(np.uint8))
-    out = _reconstruct_jit(
-        shards,
-        mask,
-        jnp.asarray(rm),
-        data_shards,
-        parity_shards,
-        not data_only,
-    )
+    if static_pattern:
+        out = _reconstruct_static_jit(
+            shards,
+            tuple(bool(b) for b in present),
+            data_shards,
+            parity_shards,
+            not data_only,
+        )
+    else:
+        rm = gf.reconstruction_matrix(data_shards, parity_shards, idx)
+        mask = jnp.asarray(present.astype(np.uint8))
+        out = _reconstruct_jit(
+            shards,
+            mask,
+            jnp.asarray(rm),
+            data_shards,
+            parity_shards,
+            not data_only,
+        )
     return out[:data_shards] if data_only else out

@@ -1,0 +1,171 @@
+"""Device-mesh parallelism for the erasure data plane.
+
+The reference scales by fanning shard I/O across disks/nodes with
+goroutines + REST (SURVEY.md section 2.4 "parallelism strategies").  The
+TPU-native analogue maps those strategies onto a jax.sharding.Mesh:
+
+* axis "stripe" (data-parallel analogue of erasure *sets*,
+  cmd/erasure-sets.go:543-580): independent stripes of a batch are placed on
+  different devices; no collectives.
+* axis "seq" (sequence-parallel analogue of the 10 MiB block streaming,
+  cmd/object-api-common.go:31): the byte stream of one object is sharded
+  along its length; RS is column-local so each device encodes its slice
+  independently - unbounded object size with a fixed per-device working set.
+* axis "shard" (tensor-parallel analogue of the per-disk shard fan-out in
+  cmd/erasure-encode.go:39-54): the k data shards are sharded across
+  devices; each device computes a partial parity (XOR of its terms) and
+  partials are combined with a recursive-doubling XOR all-reduce over ICI.
+
+All entry points work under jit/shard_map with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import gf, rs
+
+
+def make_mesh(
+    devices: "list[jax.Device] | None" = None,
+    stripe: int | None = None,
+    shard: int | None = None,
+) -> Mesh:
+    """Build a ("stripe", "shard") mesh over the available devices.
+
+    Defaults to putting all devices on the stripe axis (pure
+    set-parallelism) since XOR all-reduce traffic is then zero, mirroring
+    the reference's default of independent sets per object.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if stripe is None and shard is None:
+        stripe, shard = n, 1
+    elif stripe is None:
+        stripe = n // shard
+    elif shard is None:
+        shard = n // stripe
+    if stripe * shard != n:
+        raise ValueError(f"mesh {stripe}x{shard} != {n} devices")
+    arr = np.asarray(devices).reshape(stripe, shard)
+    return Mesh(arr, ("stripe", "shard"))
+
+
+def xor_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
+    """All-reduce with XOR over a mesh axis via recursive doubling.
+
+    GF(2^8) addition is XOR, which psum cannot express; this is the
+    collective backing shard-parallel parity accumulation.  Rides ICI as
+    log2(n) ppermute steps (falls back to all-gather+fold for non powers
+    of two).
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if n & (n - 1) == 0:
+        idx = jax.lax.axis_index(axis_name)
+        step = 1
+        while step < n:
+            # partner = idx XOR step; ppermute perm maps src->dst
+            perm = [(int(i), int(i ^ step)) for i in range(n)]
+            other = jax.lax.ppermute(x, axis_name, perm)
+            x = x ^ other
+            step <<= 1
+        return x
+    gathered = jax.lax.all_gather(x, axis_name)  # (n, ...)
+    return jax.lax.reduce(
+        gathered, x.dtype.type(0), jax.lax.bitwise_xor, (0,)
+    )
+
+
+def _partial_parity(
+    local_data_words: jax.Array, matrix_cols: np.ndarray
+) -> jax.Array:
+    """Partial parity for a device's slice of data shards (static matrix)."""
+    return rs._encode_words(local_data_words, matrix_cols)
+
+
+def sharded_encode(
+    mesh: Mesh, data: jax.Array, parity_shards: int
+) -> jax.Array:
+    """Encode a batch of stripes across the mesh.
+
+    data: (batch, k, length) uint8, batch sharded over "stripe", the k data
+    shards sharded over "shard".  Returns (batch, m, length) parity
+    replicated over "shard" (each shard-group device holds the full parity,
+    like every disk holding its own shard after the fan-out write).
+    """
+    batch, k, length = data.shape
+    m = parity_shards
+    shard_n = mesh.shape["shard"]
+    if k % shard_n:
+        raise ValueError(f"k={k} not divisible by shard axis {shard_n}")
+    matrix = gf.parity_matrix(k, m)
+    k_local = k // shard_n
+
+    def step(local: jax.Array) -> jax.Array:
+        # local: (batch/stripe_n, k_local, length)
+        idx = jax.lax.axis_index("shard")
+        words = rs.bytes_to_words(local)
+
+        def one_stripe(w):
+            # select this device's columns of the generator matrix
+            cols = jnp.stack(
+                [
+                    jnp.asarray(matrix[:, s * k_local : (s + 1) * k_local])
+                    for s in range(shard_n)
+                ]
+            )  # (shard_n, m, k_local) - static stack, dynamic row pick
+            my_cols = cols[idx]
+            partial = rs._matmul_words_dynamic(w, my_cols)
+            return partial
+
+        partial = jax.vmap(one_stripe)(words)
+        total = xor_allreduce(partial, "shard")
+        return rs.words_to_bytes(total)
+
+    fn = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=P("stripe", "shard", None),
+        out_specs=P("stripe", None, None),
+        check_vma=False,
+    )
+    return fn(data)
+
+
+def sharded_encode_seq(mesh: Mesh, data: jax.Array, parity_shards: int) -> jax.Array:
+    """Sequence-parallel encode: one long object sharded along its length.
+
+    data: (k, length) with length sharded over every mesh device (both
+    axes flattened); RS columns are independent so there is no collective -
+    this is the long-context scaling path (SURVEY.md section 5
+    "long-context / sequence parallelism").
+    """
+    k, length = data.shape
+    matrix = gf.parity_matrix(k, parity_shards)
+
+    def step(local: jax.Array) -> jax.Array:
+        words = rs.bytes_to_words(local)
+        return rs.words_to_bytes(rs._encode_words(words, matrix))
+
+    fn = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=P(None, ("stripe", "shard")),
+        out_specs=P(None, ("stripe", "shard")),
+        check_vma=False,
+    )
+    return fn(data)
+
+
+def put_sharded(mesh: Mesh, x: np.ndarray, spec: P) -> jax.Array:
+    """Place a host array onto the mesh with the given partition spec."""
+    return jax.device_put(x, NamedSharding(mesh, spec))

@@ -1,0 +1,105 @@
+"""ctypes loader for the native C++ GF(2^8) codec (native/csrc/gf_cpu.cc).
+
+Builds the shared library on first use (g++ -O3 -mavx2) and caches it under
+native/build/.  This is the CPU fallback erasure backend - the counterpart
+of klauspost/reedsolomon's role in the reference - selected when no TPU is
+present or via MINIO_ERASURE_BACKEND=cpu (BASELINE.json north-star seam).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_ROOT, "native", "csrc", "gf_cpu.cc")
+_BUILD_DIR = os.path.join(_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libgf_cpu.so")
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None" = None
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "-o", _SO + ".tmp", _SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_SO + ".tmp", _SO)
+    return _SO
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            l = ctypes.CDLL(_build())
+            l.gf_matmul.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_size_t,
+            ]
+            l.gf_matmul.restype = None
+            l.gf_has_avx2.restype = ctypes.c_int
+            _lib = l
+    return _lib
+
+
+def _ptr_array(arrs: list[np.ndarray]) -> "ctypes.Array":
+    ptrs = (ctypes.c_void_p * len(arrs))()
+    for i, a in enumerate(arrs):
+        assert a.dtype == np.uint8 and a.flags.c_contiguous
+        ptrs[i] = a.ctypes.data_as(ctypes.c_void_p)
+    return ptrs
+
+
+def gf_matmul_cpu(matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """out = matrix (o, s) GF-matmul shards (s, len) -> (o, len), native."""
+    o, s = matrix.shape
+    assert shards.shape[0] == s
+    length = shards.shape[1]
+    out = np.zeros((o, length), dtype=np.uint8)
+    in_rows = [np.ascontiguousarray(shards[i]) for i in range(s)]
+    out_rows = [out[i] for i in range(o)]
+    lib().gf_matmul(
+        o, s, np.ascontiguousarray(matrix, dtype=np.uint8).tobytes(),
+        _ptr_array(in_rows), _ptr_array(out_rows), length,
+    )
+    return out
+
+
+def encode_cpu(data: np.ndarray, parity_shards: int) -> np.ndarray:
+    """Native-CPU RS encode: (k, len) -> (m, len)."""
+    from ..ops import gf
+
+    return gf_matmul_cpu(gf.parity_matrix(data.shape[0], parity_shards), data)
+
+
+def reconstruct_cpu(
+    shards: np.ndarray,
+    present: np.ndarray,
+    data_shards: int,
+    parity_shards: int,
+) -> np.ndarray:
+    """Native-CPU RS reconstruct of the data rows: -> (k, len)."""
+    from ..ops import gf
+
+    present = np.asarray(present, dtype=bool)
+    idx = tuple(int(i) for i in np.nonzero(present)[0])
+    rm = gf.reconstruction_matrix(data_shards, parity_shards, idx)
+    survivors = shards[list(idx[:data_shards])]
+    return gf_matmul_cpu(rm, survivors)
+
+
+def has_avx2() -> bool:
+    return bool(lib().gf_has_avx2())

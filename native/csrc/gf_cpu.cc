@@ -1,0 +1,128 @@
+// Native CPU GF(2^8) matrix codec: the host fallback for the TPU erasure
+// data plane, and the in-repo AVX2 baseline bench.py measures against.
+//
+// Implements the same technique as the reference's codec dependency
+// (klauspost/reedsolomon v1.9.9 AVX2 assembly, wrapped by
+// cmd/erasure-coding.go): multiply-by-constant via two 16-entry nibble
+// tables applied with PSHUFB/VPSHUFB, XOR-accumulated across input shards.
+// Scalar table fallback when AVX2 is unavailable.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr unsigned kPoly = 0x11d;
+
+struct Tables {
+  uint8_t mul[256][256];
+  // nibble tables: low[c][x] = c*x for x in 0..15, high[c][x] = c*(x<<4)
+  uint8_t low[256][16];
+  uint8_t high[256][16];
+  Tables() {
+    // build via Russian-peasant multiply (no log/exp edge cases)
+    for (unsigned a = 0; a < 256; ++a) {
+      for (unsigned b = 0; b < 256; ++b) {
+        unsigned x = a, y = b, r = 0;
+        while (y) {
+          if (y & 1) r ^= x;
+          x <<= 1;
+          if (x & 0x100) x ^= kPoly;
+          y >>= 1;
+        }
+        mul[a][b] = static_cast<uint8_t>(r);
+      }
+    }
+    for (unsigned c = 0; c < 256; ++c) {
+      for (unsigned x = 0; x < 16; ++x) {
+        low[c][x] = mul[c][x];
+        high[c][x] = mul[c][x << 4];
+      }
+    }
+  }
+};
+
+const Tables& tables() {
+  static Tables t;
+  return t;
+}
+
+// out ^= c * in over len bytes
+void mul_acc_scalar(uint8_t c, const uint8_t* in, uint8_t* out, size_t len) {
+  const uint8_t* row = tables().mul[c];
+  for (size_t i = 0; i < len; ++i) out[i] ^= row[in[i]];
+}
+
+#if defined(__AVX2__)
+void mul_acc_avx2(uint8_t c, const uint8_t* in, uint8_t* out, size_t len) {
+  const Tables& t = tables();
+  const __m128i lo128 = _mm_loadu_si128(
+      reinterpret_cast<const __m128i*>(t.low[c]));
+  const __m128i hi128 = _mm_loadu_si128(
+      reinterpret_cast<const __m128i*>(t.high[c]));
+  const __m256i lo = _mm256_broadcastsi128_si256(lo128);
+  const __m256i hi = _mm256_broadcastsi128_si256(hi128);
+  const __m256i mask = _mm256_set1_epi8(0x0f);
+  size_t i = 0;
+  for (; i + 32 <= len; i += 32) {
+    __m256i v = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(in + i));
+    __m256i vlo = _mm256_and_si256(v, mask);
+    __m256i vhi = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+    __m256i prod = _mm256_xor_si256(_mm256_shuffle_epi8(lo, vlo),
+                                    _mm256_shuffle_epi8(hi, vhi));
+    __m256i o = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(out + i));
+    _mm256_storeu_si256(reinterpret_cast<__m256i*>(out + i),
+                        _mm256_xor_si256(o, prod));
+  }
+  if (i < len) mul_acc_scalar(c, in + i, out + i, len - i);
+}
+#endif
+
+void mul_acc(uint8_t c, const uint8_t* in, uint8_t* out, size_t len) {
+  if (c == 0) return;
+#if defined(__AVX2__)
+  mul_acc_avx2(c, in, out, len);
+#else
+  mul_acc_scalar(c, in, out, len);
+#endif
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[r] = XOR_c matrix[r*in_n + c] * in[c], for r in [0, out_n).
+// Each shard is `len` bytes. Out rows are zeroed first.
+void gf_matmul(int out_n, int in_n, const uint8_t* matrix,
+               const uint8_t* const* in, uint8_t* const* out, size_t len) {
+  for (int r = 0; r < out_n; ++r) {
+    std::memset(out[r], 0, len);
+    for (int c = 0; c < in_n; ++c) {
+      mul_acc(matrix[r * in_n + c], in[c], out[r], len);
+    }
+  }
+}
+
+// Convenience single mul-acc (used by tests)
+void gf_mul_acc(uint8_t c, const uint8_t* in, uint8_t* out, size_t len) {
+  mul_acc(c, in, out, len);
+}
+
+int gf_has_avx2(void) {
+#if defined(__AVX2__)
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+}  // extern "C"

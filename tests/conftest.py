@@ -8,12 +8,21 @@ TPU hardware.  Must run before jax initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon sitecustomize registers the TPU backend at interpreter startup
+# (before conftest), freezing JAX_PLATFORMS=axon from the environment.
+# Force the virtual 8-device CPU platform via the config API instead, which
+# still works as long as no backend has been *initialized* yet.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 

@@ -1,0 +1,70 @@
+"""Fused encode+hash / decode+verify step tests (the device hot path)."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import codec_step, gf, hash as ph, rs
+
+
+def _stripes(batch, k, length, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (batch, k, length)).astype(np.uint8)
+
+
+def test_encode_and_hash_matches_components():
+    batch, k, m, L = 3, 4, 2, 1024
+    data = _stripes(batch, k, L)
+    shards, digests = codec_step.encode_and_hash(data, m)
+    shards, digests = np.asarray(shards), np.asarray(digests)
+    assert shards.shape == (batch, k + m, L)
+    assert digests.shape == (batch, k + m, 8)
+    for b in range(batch):
+        assert np.array_equal(shards[b, :k], data[b])
+        assert np.array_equal(shards[b, k:], gf.encode_ref(data[b], m))
+        for s in range(k + m):
+            want = ph.phash256_host(shards[b, s].tobytes())
+            assert digests[b, s].tobytes() == want
+
+
+def test_verify_hashes_flags_corruption():
+    batch, k, m, L = 2, 4, 2, 512
+    data = _stripes(batch, k, L, seed=3)
+    shards, digests = codec_step.encode_and_hash(data, m)
+    shards = np.asarray(shards).copy()
+    shards[1, 2, 100] ^= 0x40
+    ok = np.asarray(codec_step.verify_hashes(shards, digests, L))
+    assert ok.shape == (batch, k + m)
+    assert ok.all(axis=1)[0]
+    assert not ok[1, 2]
+    assert ok[1, [0, 1, 3, 4, 5]].all()
+
+
+def test_decode_and_verify_reconstructs_through_bitrot():
+    k, m, L = 8, 4, 2048
+    data = _stripes(1, k, L, seed=4)[0]
+    shards, digests = codec_step.encode_and_hash(data[None], m)
+    shards = np.asarray(shards)[0].copy()
+    digests = np.asarray(digests)[0]
+    # corrupt m shards (mix of data and parity)
+    for i in (0, 3, 9, 11):
+        shards[i, ::7] ^= 0xFF
+    got, ok = codec_step.decode_and_verify(shards, digests, k, m)
+    assert np.array_equal(np.asarray(got), data)
+    assert list(np.nonzero(~ok)[0]) == [0, 3, 9, 11]
+
+
+def test_decode_and_verify_below_quorum_raises():
+    k, m, L = 4, 2, 256
+    data = _stripes(1, k, L, seed=5)[0]
+    shards, digests = codec_step.encode_and_hash(data[None], m)
+    shards = np.asarray(shards)[0].copy()
+    digests = np.asarray(digests)[0]
+    for i in (0, 1, 2):  # 3 corrupt of 6 -> only 3 intact < k=4
+        shards[i, 0] ^= 1
+    with pytest.raises(ValueError, match="bitrot"):
+        codec_step.decode_and_verify(shards, digests, k, m)
+
+
+def test_unaligned_shard_len_rejected():
+    with pytest.raises(ValueError, match="multiple of 32"):
+        codec_step.encode_and_hash(np.zeros((1, 4, 48), np.uint8), 2)

@@ -1,0 +1,83 @@
+"""Mesh parallelism tests on the virtual 8-device CPU mesh.
+
+Exercises the sharding strategies of SURVEY.md section 2.4 the way the
+reference's in-process multi-disk layouts do (test-utils_test.go:185-202).
+"""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import gf
+from minio_tpu.parallel import mesh as pm
+
+
+def test_make_mesh_shapes():
+    m = pm.make_mesh()
+    assert m.shape["stripe"] * m.shape["shard"] == 8
+    m2 = pm.make_mesh(stripe=2, shard=4)
+    assert dict(m2.shape) == {"stripe": 2, "shard": 4}
+    with pytest.raises(ValueError):
+        pm.make_mesh(stripe=3, shard=3)
+
+
+@pytest.mark.parametrize("axis_n", [2, 4, 8])
+def test_xor_allreduce_pow2(axis_n):
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.asarray(jax.devices()[:axis_n])
+    mesh = Mesh(devs, ("x",))
+    vals = np.random.default_rng(axis_n).integers(
+        0, 2**32, (axis_n, 16), dtype=np.uint32
+    )
+    fn = jax.shard_map(
+        lambda v: pm.xor_allreduce(v, "x"),
+        mesh=mesh,
+        in_specs=P("x", None),
+        out_specs=P("x", None),
+        check_vma=False,
+    )
+    out = np.asarray(fn(vals))
+    expect = np.bitwise_xor.reduce(vals, axis=0)
+    for d in range(axis_n):
+        assert np.array_equal(out[d], expect)
+
+
+@pytest.mark.parametrize("stripe,shard", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_encode_all_mesh_shapes(stripe, shard):
+    mesh = pm.make_mesh(stripe=stripe, shard=shard)
+    B, k, m, L = max(2, stripe), 8, 4, 512
+    rng = np.random.default_rng(stripe * 10 + shard)
+    data = rng.integers(0, 256, (B, k, L)).astype(np.uint8)
+    dd = pm.put_sharded(mesh, data, pm.P("stripe", "shard", None))
+    parity = np.asarray(pm.sharded_encode(mesh, dd, m))
+    expect = np.stack([gf.encode_ref(data[b], m) for b in range(B)])
+    assert np.array_equal(parity, expect)
+
+
+def test_sharded_encode_seq_long_object():
+    mesh = pm.make_mesh(stripe=4, shard=2)
+    k, m = 4, 2
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (k, 8 * 1024)).astype(np.uint8)
+    ds = pm.put_sharded(mesh, data, pm.P(None, ("stripe", "shard")))
+    parity = np.asarray(pm.sharded_encode_seq(mesh, ds, m))
+    assert np.array_equal(parity, gf.encode_ref(data, m))
+
+
+def test_graft_entry_single_chip():
+    import jax
+
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    shards, digests = jax.jit(fn)(*args)
+    batch, k, L = args[0].shape
+    assert shards.shape == (batch, k + 4, L)
+    assert digests.shape == (batch, k + 4, 8)
+
+
+def test_graft_entry_dryrun_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
