@@ -91,18 +91,21 @@ def bench_tpu() -> dict:
     from minio_tpu.ops import codec_step, gf
 
     rng = np.random.default_rng(0)
-    data = jnp.asarray(
-        rng.integers(0, 256, (BATCH, EC_K, SHARD_LEN), dtype=np.uint8)
+    words = jnp.asarray(
+        rng.integers(
+            0, 2**32, (BATCH, EC_K, SHARD_LEN // 4), dtype=np.uint32
+        )
     )
     data_bytes = BATCH * BLOCK
 
     def run_enc(r):
-        out = codec_step.encode_throughput_probe(data, EC_M, r)
+        out = codec_step.encode_throughput_probe(words, EC_M, SHARD_LEN, r)
         np.asarray(out[0])
 
     t_enc = _marginal_time(run_enc)
 
-    shards, _ = codec_step.encode_and_hash(data, EC_M)
+    parity, _ = codec_step.encode_and_hash_words(words, EC_M, SHARD_LEN)
+    shards = jnp.concatenate([words, parity], axis=1)
     present = np.ones(EC_K + EC_M, dtype=bool)
     present[[0, 3, 9, 11]] = False  # 2 data + 2 parity lost
     present_t = tuple(bool(b) for b in present)

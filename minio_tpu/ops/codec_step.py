@@ -3,8 +3,15 @@
 The reference's PutObject hot loop does RS-encode on CPU and then streams
 each shard through a HighwayHash writer (cmd/erasure-encode.go:73-109 +
 cmd/bitrot-streaming.go:38-88) - two passes over every byte.  Here both
-happen in a single fused XLA program per batch: parity generation and the
-per-shard bitrot digest read each byte from HBM once.
+happen in a single fused device pass per batch: parity generation and the
+per-shard bitrot digest read each data byte from HBM once, and only parity
++ digests leave the device (the host already holds the data bytes).
+
+Layout contract: the device works exclusively on uint32 "words" (4 field
+elements per lane).  uint8<->uint32 bitcasts on TPU are full relayouts
+((32,128) vs (8,128) tiling) costing more than the codec itself, so byte
+views happen host-side where numpy's .view() is free.  Use
+host_bytes_to_words / host_words_to_bytes at the boundary.
 
 These are the kernels the object layer batches concurrent requests into
 (the analogue of erasure-sets feeding per-disk queues).
@@ -18,70 +25,193 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import gf, hash as phash, rs
+from . import gf, hash as phash, rs, rs_pallas
 
 
-@functools.partial(jax.jit, static_argnames=("parity_shards",))
-def encode_and_hash(data: jax.Array, parity_shards: int):
+def host_bytes_to_words(a: np.ndarray) -> np.ndarray:
+    """(..., L) uint8 -> (..., L//4) uint32 view (host, zero-copy)."""
+    assert a.dtype == np.uint8 and a.shape[-1] % 4 == 0
+    a = np.ascontiguousarray(a)
+    return a.view(np.uint32)
+
+
+def host_words_to_bytes(a: np.ndarray) -> np.ndarray:
+    """(..., w) uint32 -> (..., 4w) uint8 view (host, zero-copy)."""
+    assert a.dtype == np.uint32
+    return np.ascontiguousarray(a).view(np.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("parity_shards", "shard_len"))
+def encode_and_hash_words(
+    words: jax.Array, parity_shards: int, shard_len: int
+):
     """Encode + bitrot-hash a batch of stripes in one fused pass.
 
-    data: (batch, k, shard_len) uint8, shard_len % 32 == 0.
-    Returns (shards, digests):
-      shards:  (batch, k+m, shard_len) uint8 - data rows then parity rows
-               (the write fan-out order of cmd/erasure-encode.go:39-54)
-      digests: (batch, k+m, 8) uint32 phash256 per shard block.
+    words: (batch, k, w) uint32 data shards; shard_len = 4*w (bytes).
+    Returns (parity, digests):
+      parity:  (batch, m, w) uint32 parity shards
+      digests: (batch, k+m, 8) uint32 finalized phash256 per shard
+               (data rows first, then parity - the fan-out order of
+               cmd/erasure-encode.go:39-54).
     """
-    batch, k, shard_len = data.shape
+    batch, k, w = words.shape
     m = parity_shards
-    if shard_len % 32:
-        raise ValueError("shard_len must be a multiple of 32 bytes")
+    if shard_len != 4 * w:
+        raise ValueError("shard_len must equal 4 * words-per-shard")
+    if w % 8:
+        raise ValueError("words per shard must be a multiple of 8")
     matrix = gf.parity_matrix(k, m)
 
-    def one(stripe: jax.Array):
-        words = rs.bytes_to_words(stripe)  # (k, w)
-        parity = rs._encode_words(words, matrix)  # (m, w)
-        all_words = jnp.concatenate([words, parity], axis=0)
-        digests = jax.vmap(
-            lambda w: phash.phash256_words(w, shard_len)
-        )(all_words)
-        return rs.words_to_bytes(all_words), digests
+    if jax.default_backend() == "tpu" and w % rs_pallas._TW == 0:
+        parity, partials = rs_pallas.encode_hash_fused(words, m)
+        return parity, phash.finalize_partials(partials, shard_len)
 
-    return jax.vmap(one)(data)
+    # Portable path: RS is column-local, so a batch is ONE flat encode of
+    # (k, B*w) - no vmap-of-small-ops - and hashing is one batched pass.
+    flat = words.transpose(1, 0, 2).reshape(k, batch * w)
+    parity = rs._matmul_static(flat, matrix).reshape(m, batch, w)
+    aw = jnp.concatenate(
+        [words.transpose(1, 0, 2), parity], axis=0
+    )  # (n, B, w)
+    digests = phash.phash256_words_batched(aw, shard_len)  # (n, B, 8)
+    return parity.transpose(1, 0, 2), digests.transpose(1, 0, 2)
 
 
 @functools.partial(jax.jit, static_argnames=("shard_len",))
-def verify_hashes(shards: jax.Array, digests: jax.Array, shard_len: int):
-    """Recompute phash256 for (batch, n, shard_len) shards, compare.
+def verify_hashes_words(
+    shards: jax.Array, digests: jax.Array, shard_len: int
+):
+    """Recompute phash256 for (batch, n, w) uint32 shards, compare.
 
     Returns (batch, n) bool - True where the shard is intact.  This is the
     read-side bitrot verification (cmd/bitrot-streaming.go:130-146 /
     xl-storage.go bitrotVerify) as one device pass over all shards.
     """
-    def one(shard, want):
-        words = rs.bytes_to_words(shard)
-        got = phash.phash256_words(words, shard_len)
-        return jnp.all(got == want)
-
-    return jax.vmap(jax.vmap(one))(shards, digests)
+    got = phash.phash256_words_batched(shards, shard_len)  # (B, n, 8)
+    return jnp.all(got == digests, axis=-1)
 
 
-@functools.partial(jax.jit, static_argnames=("parity_shards", "reps"))
-def encode_throughput_probe(data: jax.Array, parity_shards: int, reps: int):
+@functools.partial(
+    jax.jit, static_argnames=("present", "data_shards", "parity_shards")
+)
+def reconstruct_words_batch(
+    shards: jax.Array,
+    present: tuple[bool, ...],
+    data_shards: int,
+    parity_shards: int,
+):
+    """Static-pattern batched reconstruct: (B, n, w) -> (B, k, w) words.
+
+    Column-locality makes the whole batch one flat (k, B*w) matmul with
+    the pattern's inverted sub-matrix (rows where present is False hold
+    garbage and are ignored).
+    """
+    k, m = data_shards, parity_shards
+    idx = [i for i, p in enumerate(present) if p][:k]
+    if len(idx) < k:
+        raise ValueError(f"need {k} shards, have {len(idx)}")
+    rm = gf.reconstruction_matrix(k, m, tuple(idx))
+    B, n, w = shards.shape
+    flat = shards.transpose(1, 0, 2).reshape(n, B * w)
+    surv = jnp.stack([flat[i] for i in idx])
+    dw = rs._matmul_static(surv, rm)  # (k, B*w)
+    return dw.reshape(k, B, w).transpose(1, 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# Byte-domain convenience wrappers (tests, small host-side uses)
+# ---------------------------------------------------------------------------
+
+
+def encode_and_hash(data, parity_shards: int):
+    """Byte-domain wrapper: (B, k, L) u8 -> ((B, n, L) u8, (B, n, 8) u32).
+
+    Host-side byte views; prefer the *_words APIs on the hot path.
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    batch, k, shard_len = data.shape
+    if shard_len % 32:
+        raise ValueError("shard_len must be a multiple of 32 bytes")
+    words = jnp.asarray(host_bytes_to_words(data))
+    parity, digests = encode_and_hash_words(
+        words, parity_shards, shard_len
+    )
+    parity_b = host_words_to_bytes(np.asarray(parity))
+    shards = np.concatenate([data, parity_b], axis=1)
+    return shards, np.asarray(digests)
+
+
+def verify_hashes(shards, digests, shard_len: int):
+    """Byte-domain wrapper over verify_hashes_words."""
+    shards = np.asarray(shards, dtype=np.uint8)
+    words = jnp.asarray(host_bytes_to_words(shards))
+    return np.asarray(
+        verify_hashes_words(words, jnp.asarray(digests), shard_len)
+    )
+
+
+def decode_and_verify(
+    shards: np.ndarray,
+    digests: np.ndarray,
+    data_shards: int,
+    parity_shards: int,
+):
+    """Read-path step: verify bitrot, reconstruct from intact shards.
+
+    Host-driven composition (the erasure-decode.go:211-290 Decode
+    semantics: verify every block read, escalate to parity on failure,
+    flag heal when any shard was bad).
+
+    Returns (data, ok_mask): data (k, shard_len) uint8, ok_mask (n,) bool.
+    Raises ValueError when fewer than k shards are intact (errXLReadQuorum
+    analogue).
+    """
+    n = data_shards + parity_shards
+    shard_len = shards.shape[-1]
+    words = jnp.asarray(host_bytes_to_words(np.asarray(shards)))
+    ok = np.asarray(
+        verify_hashes_words(words[None], jnp.asarray(digests)[None], shard_len)[0]
+    )
+    if int(ok.sum()) < data_shards:
+        raise ValueError(
+            f"bitrot: only {int(ok.sum())}/{n} shards intact, "
+            f"need {data_shards}"
+        )
+    dw = reconstruct_words_batch(
+        words[None],
+        tuple(bool(b) for b in ok),
+        data_shards,
+        parity_shards,
+    )[0]
+    data = host_words_to_bytes(np.asarray(dw))
+    return data, ok
+
+
+# ---------------------------------------------------------------------------
+# Benchmark probes (chained device passes, see bench.py)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("parity_shards", "shard_len", "reps")
+)
+def encode_throughput_probe(
+    words: jax.Array, parity_shards: int, shard_len: int, reps: int
+):
     """Run `reps` dependent encode+hash passes inside ONE device program.
 
-    Benchmarking aid: chains iterations through a cheap XOR so XLA cannot
-    elide work, letting per-pass device time be measured without host
-    launch overhead (significant over the dev relay).  Returns a small
-    checksum array.
+    Chains iterations through a cheap XOR so XLA cannot elide work,
+    letting per-pass device time be measured without host launch overhead
+    (significant over the dev relay).  Returns a small checksum array.
     """
-    k = data.shape[1]
-
     def body(carry, _):
-        shards, digests = encode_and_hash(carry, parity_shards)
-        nxt = shards[:, :k] ^ shards[:, k : k + 1]
+        parity, digests = encode_and_hash_words(
+            carry, parity_shards, shard_len
+        )
+        nxt = carry ^ parity[:, :1]
         return nxt, digests[0, 0, 0]
 
-    final, sums = jax.lax.scan(body, data, None, length=reps)
+    final, sums = jax.lax.scan(body, words, None, length=reps)
     return final[0, 0, :8], sums
 
 
@@ -97,49 +227,14 @@ def reconstruct_throughput_probe(
     reps: int,
 ):
     """Chained batched static-pattern reconstructs (see encode probe)."""
-    from . import rs as _rs
-
-    def one(s):
-        return _rs._reconstruct_static_jit(
-            s, present, data_shards, parity_shards, False
-        )
+    k = data_shards
 
     def body(carry, _):
-        data = jax.vmap(one)(carry)
-        nxt = carry ^ jnp.concatenate(
-            [data, jnp.zeros_like(carry[:, data_shards:])], axis=1
+        data = reconstruct_words_batch(
+            carry, present, data_shards, parity_shards
         )
+        nxt = carry.at[:, :k].set(carry[:, :k] ^ data)
         return nxt, data[0, 0, 0]
 
     final, sums = jax.lax.scan(body, shards, None, length=reps)
     return final[0, 0, :8], sums
-
-
-def decode_and_verify(
-    shards: np.ndarray,
-    digests: np.ndarray,
-    data_shards: int,
-    parity_shards: int,
-):
-    """Read-path step: verify bitrot, reconstruct from intact shards.
-
-    Host-driven composition of verify_hashes + rs.reconstruct (the
-    erasure-decode.go:211-290 Decode semantics: verify every block read,
-    escalate to parity on failure, flag heal when any shard was bad).
-
-    Returns (data, ok_mask): data (k, shard_len) uint8, ok_mask (n,) bool.
-    Raises ValueError when fewer than k shards are intact (errXLReadQuorum
-    analogue).
-    """
-    n = data_shards + parity_shards
-    shard_len = shards.shape[-1]
-    ok = np.asarray(
-        verify_hashes(shards[None], digests[None], shard_len)[0]
-    )
-    if int(ok.sum()) < data_shards:
-        raise ValueError(
-            f"bitrot: only {int(ok.sum())}/{n} shards intact, "
-            f"need {data_shards}"
-        )
-    data = rs.reconstruct(shards, ok, data_shards, parity_shards)
-    return data, ok

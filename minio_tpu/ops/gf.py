@@ -144,6 +144,7 @@ def parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
     return rs_matrix(data_shards, parity_shards)[data_shards:, :].copy()
 
 
+@functools.lru_cache(maxsize=4096)
 def reconstruction_matrix(
     data_shards: int, parity_shards: int, present: tuple[int, ...]
 ) -> np.ndarray:

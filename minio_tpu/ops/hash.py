@@ -55,8 +55,11 @@ def _digest_np(words: np.ndarray, nbytes: int) -> np.ndarray:
     key = _mix_np(idx * _C1 + np.uint32(1))
     m1 = _mix_np((words ^ key) * _M1)
     m2 = _mix_np((words + key) * _M2)
-    p1 = np.bitwise_xor.reduce(m1.reshape(_PARTS, -1), axis=1)
-    p2 = np.bitwise_xor.reduce(m2.reshape(_PARTS, -1), axis=1)
+    # Strided (word-index mod 4) partitions: any contiguous chunk of the
+    # stream reduces to 4 partials independently, which lets the device
+    # kernel fold tile partials in any order (see rs_pallas fused kernel).
+    p1 = np.bitwise_xor.reduce(m1.reshape(-1, _PARTS), axis=0)
+    p2 = np.bitwise_xor.reduce(m2.reshape(-1, _PARTS), axis=0)
     out = np.concatenate([p1, p2])
     # fold in total length so truncation/extension changes every word
     lenmix = (np.uint64(nbytes) * np.uint64(_C1)).astype(np.uint32)
@@ -101,14 +104,68 @@ def phash256_words(words, nbytes: int):
     (n,) = words.shape
     if n % _PARTS:
         raise ValueError(f"word count {n} must be a multiple of {_PARTS}")
+    return phash256_words_batched(words[None], nbytes)[0]
+
+
+def phash256_words_batched(words, nbytes: int):
+    """Device digest over the LAST axis: (..., w) uint32 -> (..., 8).
+
+    Vectorized over leading axes with no vmap - every op is a full-size
+    array op, so hashing (n_shards, batch, w) is one VPU pass.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = words.shape[-1]
+    if n % _PARTS:
+        raise ValueError(f"word count {n} must be a multiple of {_PARTS}")
+    lead = words.shape[:-1]
     idx = jax.lax.iota(jnp.uint32, n)
     key = _mix_jnp(idx * _C1 + jnp.uint32(1))
     m1 = _mix_jnp((words ^ key) * _M1)
     m2 = _mix_jnp((words + key) * _M2)
     red = lambda m: jax.lax.reduce(
-        m.reshape(_PARTS, -1), np.uint32(0), jax.lax.bitwise_xor, (1,)
+        m.reshape(*lead, n // _PARTS, _PARTS),
+        np.uint32(0),
+        jax.lax.bitwise_xor,
+        (len(lead),),
     )
-    out = jnp.concatenate([red(m1), red(m2)])
+    out = jnp.concatenate([red(m1), red(m2)], axis=-1)  # (..., 8)
     return _mix_jnp(
         out ^ jnp.uint32(nbytes) * _C1 + jax.lax.iota(jnp.uint32, 8)
+    )
+
+
+def tile_partials(words, key):
+    """XOR partials of one contiguous tile for the fused Pallas kernel.
+
+    words, key: (w,) uint32 (key = _mix(global_index * C1 + 1) for the
+    tile's global word positions).  Returns (8,) uint32: 4 partials of the
+    m1 mix then 4 of m2.  XOR-fold partials of all tiles, then apply
+    finalize_partials to obtain phash256_words output.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = words.shape[-1]
+    m1 = _mix_jnp((words ^ key) * _M1)
+    m2 = _mix_jnp((words + key) * _M2)
+    red = lambda m: jax.lax.reduce(
+        m.reshape(n // _PARTS, _PARTS),
+        np.uint32(0),
+        jax.lax.bitwise_xor,
+        (0,),
+    )
+    return jnp.concatenate([red(m1), red(m2)])
+
+
+def finalize_partials(partials, nbytes: int):
+    """Length-fold of XOR-combined tile partials: (..., 8) -> (..., 8)."""
+    import jax
+    import jax.numpy as jnp
+
+    return _mix_jnp(
+        partials
+        ^ jnp.uint32(nbytes) * _C1
+        + jax.lax.iota(jnp.uint32, 8)
     )

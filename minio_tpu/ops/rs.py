@@ -99,6 +99,20 @@ def _encode_words(data_words: jax.Array, matrix: np.ndarray) -> jax.Array:
     return jnp.stack(rows)
 
 
+def _matmul_static(words: jax.Array, matrix: np.ndarray) -> jax.Array:
+    """Static-matrix GF matmul: Pallas kernel on TPU, fused XLA elsewhere.
+
+    Trace-time dispatch: on the TPU backend the tiled VMEM kernel
+    (rs_pallas.matmul_words) is ~15x the fused-XLA path; CPU tests and
+    the virtual multi-chip mesh take the portable jnp path.
+    """
+    if jax.default_backend() == "tpu":
+        from . import rs_pallas
+
+        return rs_pallas.matmul_words(matrix, words, interpret=False)
+    return _encode_words(words, matrix)
+
+
 def _matmul_words_dynamic(shards_words: jax.Array, matrix: jax.Array) -> jax.Array:
     """(s, w) uint32 x traced (o, s) uint8 matrix -> (o, w) uint32.
 
@@ -135,7 +149,7 @@ def _xor_reduce(x: jax.Array, axis: int) -> jax.Array:
 def _encode_jit(data: jax.Array, data_shards: int, parity_shards: int) -> jax.Array:
     matrix = gf.parity_matrix(data_shards, parity_shards)
     words = bytes_to_words(data)
-    parity = _encode_words(words, matrix)
+    parity = _matmul_static(words, matrix)
     return words_to_bytes(parity)
 
 
@@ -212,9 +226,9 @@ def _reconstruct_static_jit(
     rm = gf.reconstruction_matrix(k, m, idx)
     words = bytes_to_words(shards)
     survivors = jnp.stack([words[i] for i in idx])
-    data_words = _encode_words(survivors, rm)
+    data_words = _matmul_static(survivors, rm)
     if want_parity:
-        parity = _encode_words(data_words, gf.parity_matrix(k, m))
+        parity = _matmul_static(data_words, gf.parity_matrix(k, m))
         all_words = jnp.concatenate([data_words, parity], axis=0)
     else:
         all_words = data_words

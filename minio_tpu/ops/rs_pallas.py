@@ -1,0 +1,296 @@
+"""Pallas TPU kernels for the GF(2^8) shard codec hot path.
+
+Two device formulations of "GF matrix @ shards" (the klauspost/reedsolomon
+role behind cmd/erasure-coding.go:54-64):
+
+1. SWAR/VPU kernel (`matmul_words`, the default): shards live as uint32
+   words (4 field elements per lane).  Multiply-by-constant uses the
+   xtime-powers decomposition with the generator matrix baked into the
+   kernel at trace time, so each tile is a straight-line XOR chain over
+   VMEM-resident vectors - no tables, no gathers, no dtype conversions.
+   Measured ~450 GiB/s data throughput at EC 8+4 on v5e-1 (HBM-bound:
+   the kernel reads each data byte and writes each parity byte once).
+
+2. MXU bit-matrix kernel (`gf_matmul_mxu`): GF(2^8) mul-by-constant is an
+   8x8 linear map over GF(2), so the whole codec lifts to one
+   (8o x 8s) @ (8s x T) bf16 matmul per tile, mod 2.  Higher arithmetic
+   intensity but pays ~30 VPU ops/byte in bit unpack/repack, which caps it
+   below the SWAR kernel at storage geometries (k <= 16).  Kept as the
+   backend for very wide/dense matrices and as MXU reference.
+
+Both run under interpret mode for CPU tests; production dispatch lives in
+rs.encode / rs.reconstruct.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from . import gf, rs
+
+# uint32 words per shard per tile (16 KiB of shard bytes per grid step)
+_TW = 4096
+# lane-dim tile for the MXU kernel: bytes per shard per grid step
+_T_BLK = 8192
+
+
+def _swar_kernel(matrix: np.ndarray):
+    """Build a Pallas kernel computing out = matrix GF@ data over a tile.
+
+    matrix (o, s) is a Python-time constant: zero coefficients and zero
+    bits are pruned from the XOR chain at trace time, and xtime powers of
+    each input row are materialized lazily up to the highest bit any
+    coefficient in that column uses (see _swar_rows).
+    """
+    o, _ = matrix.shape
+
+    def kernel(data_ref, out_ref):
+        rows = _swar_rows(matrix, data_ref[...])
+        for r in range(o):
+            out_ref[r, :] = rows[r]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("matrix_key", "o", "s", "interpret")
+)
+def _matmul_words_jit(
+    words, matrix_key: bytes, o: int, s: int, interpret: bool
+):
+    matrix = np.frombuffer(matrix_key, dtype=np.uint8).reshape(o, s)
+    w = words.shape[1]
+    pad = (-w) % _TW
+    if pad:
+        words = jnp.pad(words, ((0, 0), (0, pad)))
+    pw = w + pad
+    out = pl.pallas_call(
+        _swar_kernel(matrix),
+        out_shape=jax.ShapeDtypeStruct((o, pw), jnp.uint32),
+        grid=(pw // _TW,),
+        in_specs=[pl.BlockSpec((s, _TW), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((o, _TW), lambda i: (0, i)),
+        interpret=interpret,
+    )(words)
+    return out[:, :w] if pad else out
+
+
+def matmul_words(
+    matrix: np.ndarray, words, interpret: "bool | None" = None
+):
+    """(o, s) static GF matrix @ (s, w) uint32 shard words -> (o, w)."""
+    o, s = matrix.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    key = np.ascontiguousarray(matrix, dtype=np.uint8).tobytes()
+    return _matmul_words_jit(words, key, o, s, interpret)
+
+
+def encode_words(data_words, parity_shards: int, interpret=None):
+    """Pallas RS encode on packed words: (k, w) -> (m, w)."""
+    k = data_words.shape[0]
+    return matmul_words(
+        gf.parity_matrix(k, parity_shards), data_words, interpret
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused encode + bitrot hash (the PutObject device pass)
+# ---------------------------------------------------------------------------
+
+
+def _fused_kernel_factory(matrix: np.ndarray, tw: int):
+    from . import hash as phash
+
+    m, k = matrix.shape
+    n = k + m
+
+    def kernel(data_ref, parity_ref, hacc_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _zero():
+            hacc_ref[...] = jnp.zeros_like(hacc_ref)
+
+        data = data_ref[0]  # (k, tw)
+        # ---- encode (same XOR chain as _swar_kernel, inlined) ----
+        parity_rows = _swar_rows(matrix, data)
+        all_rows = jnp.concatenate(
+            [data, jnp.stack(parity_rows)], axis=0
+        )  # (n, tw)
+        parity_ref[0] = all_rows[k:]
+        # ---- hash partials for this tile, all shards at once ----
+        gidx = i * tw + jax.lax.broadcasted_iota(jnp.uint32, (1, tw), 1)
+        key = phash._mix_jnp(gidx * phash._C1 + jnp.uint32(1))  # (1, tw)
+        m1 = phash._mix_jnp((all_rows ^ key) * phash._M1)
+        m2 = phash._mix_jnp((all_rows + key) * phash._M2)
+
+        def red(x):
+            # XOR-fold the lane dim down to 4: every halving step keeps
+            # index-mod-4 classes intact (all widths are multiples of 4),
+            # so the result is exactly the strided partition XOR.  Mosaic
+            # has no reduce_xor and no lane-dim shape casts; slices + xor
+            # lower cleanly.
+            width = tw
+            while width > 4:
+                width //= 2
+                x = x[:, :width] ^ x[:, width : 2 * width]
+            return x  # (n, 4)
+
+        partials = jnp.concatenate([red(m1), red(m2)], axis=1)  # (n, 8)
+        hacc_ref[0] = hacc_ref[0] ^ partials
+
+    return kernel
+
+
+def _swar_rows(matrix: np.ndarray, data) -> list:
+    """Shared XOR-chain: parity rows of a (k, t) uint32 tile (traced)."""
+    o, s = matrix.shape
+    need_bits = [
+        max((int(matrix[r, c]).bit_length() for r in range(o)), default=0)
+        for c in range(s)
+    ]
+    powers: list[list] = []
+    for c in range(s):
+        p = data[c, :]
+        ps = [p]
+        for _ in range(max(need_bits[c] - 1, 0)):
+            p = rs._xtime(p)
+            ps.append(p)
+        powers.append(ps)
+    rows = []
+    for r in range(o):
+        acc = None
+        for c in range(s):
+            coeff = int(matrix[r, c])
+            for b in range(8):
+                if (coeff >> b) & 1:
+                    t = powers[c][b]
+                    acc = t if acc is None else acc ^ t
+        if acc is None:
+            acc = jnp.zeros_like(data[0, :])
+        rows.append(acc)
+    return rows
+
+
+@functools.partial(
+    jax.jit, static_argnames=("parity_shards", "interpret")
+)
+def encode_hash_fused(words, parity_shards: int, interpret: bool = False):
+    """One kernel pass: (B, k, w) data words -> ((B, m, w) parity words,
+    (B, n, 8) un-finalized phash partials covering data AND parity rows).
+
+    Grid is (batch, w-tiles); the hash-partial output block for a stripe is
+    revisited across its w-tiles and XOR-accumulated in VMEM, so HBM
+    traffic is exactly data-in + parity-out (data shards never round-trip:
+    the host already holds their bytes).  Finalize partials with
+    hash.finalize_partials(partials, shard_len_bytes).
+    """
+    B, k, w = words.shape
+    m = parity_shards
+    n = k + m
+    matrix = gf.parity_matrix(k, m)
+    if w % _TW:
+        raise ValueError(f"words per shard ({w}) must be a multiple of {_TW}")
+    kernel = _fused_kernel_factory(matrix, _TW)
+    parity, hacc = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, m, w), jnp.uint32),
+            jax.ShapeDtypeStruct((B, n, 8), jnp.uint32),
+        ),
+        grid=(B, w // _TW),
+        in_specs=[pl.BlockSpec((1, k, _TW), lambda b, i: (b, 0, i))],
+        out_specs=(
+            pl.BlockSpec((1, m, _TW), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, n, 8), lambda b, i: (b, 0, 0)),
+        ),
+        interpret=interpret,
+    )(words)
+    return parity, hacc
+
+
+# ---------------------------------------------------------------------------
+# MXU bit-matrix variant
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _bit_matrix(matrix_bytes: bytes, o: int, s: int) -> np.ndarray:
+    """Lift an (o, s) GF(2^8) matrix to its (8o, 8s) GF(2) representation.
+
+    Row 8r+t, column 8c+b is bit t of matrix[r,c] * x^b: the contribution
+    of input-byte-c's bit b to output-byte-r's bit t.
+    """
+    matrix = np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(o, s)
+    out = np.zeros((8 * o, 8 * s), dtype=np.float32)
+    for r in range(o):
+        for c in range(s):
+            v = int(matrix[r, c])
+            for b in range(8):
+                prod = gf.gf_mul(v, 1 << b)
+                for t in range(8):
+                    out[8 * r + t, 8 * c + b] = (prod >> t) & 1
+    return out
+
+
+def _mxu_kernel(mat_ref, data_ref, out_ref):
+    o8 = mat_ref.shape[0]
+    s, t = data_ref.shape
+    x = data_ref[:].astype(jnp.int32)  # (s, T)
+    bits = jnp.stack(
+        [(x >> b) & 1 for b in range(8)], axis=1
+    )  # (s, 8, T), row order 8c+b after reshape
+    bits = bits.reshape(8 * s, t).astype(jnp.bfloat16)
+    counts = jnp.dot(
+        mat_ref[:].astype(jnp.bfloat16),
+        bits,
+        preferred_element_type=jnp.float32,
+    )  # (8o, T); exact small integers
+    pbits = counts.astype(jnp.int32) & 1
+    pbits = pbits.reshape(o8 // 8, 8, t)
+    acc = pbits[:, 0, :]
+    for tbit in range(1, 8):
+        acc = acc | (pbits[:, tbit, :] << tbit)
+    out_ref[:] = acc.astype(jnp.uint8)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("matrix_key", "o", "s", "interpret")
+)
+def _mxu_matmul_jit(shards, matrix_key: bytes, o: int, s: int, interpret):
+    length = shards.shape[1]
+    pad = (-length) % _T_BLK
+    if pad:
+        shards = jnp.pad(shards, ((0, 0), (0, pad)))
+    plen = length + pad
+    mat = jnp.asarray(_bit_matrix(matrix_key, o, s))
+    out = pl.pallas_call(
+        _mxu_kernel,
+        out_shape=jax.ShapeDtypeStruct((o, plen), jnp.uint8),
+        grid=(plen // _T_BLK,),
+        in_specs=[
+            pl.BlockSpec((8 * o, 8 * s), lambda i: (0, 0)),
+            pl.BlockSpec((s, _T_BLK), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((o, _T_BLK), lambda i: (0, i)),
+        interpret=interpret,
+    )(mat, shards)
+    return out[:, :length] if pad else out
+
+
+def gf_matmul_mxu(
+    matrix: np.ndarray, shards, interpret: "bool | None" = None
+) -> jax.Array:
+    """(o, s) GF matrix @ (s, length) u8 shards on the MXU (see module doc)."""
+    o, s = matrix.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    shards = jnp.asarray(shards, dtype=jnp.uint8)
+    key = np.ascontiguousarray(matrix, dtype=np.uint8).tobytes()
+    return _mxu_matmul_jit(shards, key, o, s, interpret)

@@ -71,9 +71,9 @@ def test_graft_entry_single_chip():
     import __graft_entry__ as ge
 
     fn, args = ge.entry()
-    shards, digests = jax.jit(fn)(*args)
-    batch, k, L = args[0].shape
-    assert shards.shape == (batch, k + 4, L)
+    parity, digests = jax.jit(fn)(*args)
+    batch, k, w = args[0].shape
+    assert parity.shape == (batch, 4, w)
     assert digests.shape == (batch, k + 4, 8)
 
 
