@@ -1,0 +1,182 @@
+"""Codec backend seam: the reedsolomon.Encoder-shaped boundary.
+
+The reference hides its codec behind reedsolomon.Encoder constructed at
+cmd/erasure-coding.go:54-64; everything above (Erasure.Encode/Decode/Heal)
+is codec-agnostic.  This module is that seam for the new framework:
+
+    backend = get_backend()        # MINIO_ERASURE_BACKEND=tpu|cpu|auto
+
+* TpuBackend: batched fused Pallas/JAX device passes (ops/codec_step).
+* CpuBackend: native C++ AVX2 nibble-shuffle codec (native/csrc/gf_cpu.cc)
+  + vectorized numpy phash256 - the klauspost/reedsolomon-equivalent host
+  path, also the fallback when no accelerator is present.
+
+Both produce byte-identical parity and digests; shard files written by one
+backend verify and decode under the other.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..ops import gf, hash as phash
+
+
+class CodecBackend:
+    """Batched erasure codec + bitrot digest interface.
+
+    Shapes are byte-domain; implementations may view as words internally.
+    """
+
+    name = "abstract"
+
+    def encode(self, data: np.ndarray, parity_shards: int):
+        """(B, k, L) u8 -> (parity (B, m, L) u8, digests (B, k+m, 8) u32).
+
+        L must be a multiple of 32.  Digest order: data rows then parity.
+        """
+        raise NotImplementedError
+
+    def reconstruct(
+        self,
+        shards: np.ndarray,
+        present: "tuple[bool, ...]",
+        data_shards: int,
+        parity_shards: int,
+    ) -> np.ndarray:
+        """(B, n, L) u8 + survivor mask -> (B, k, L) u8 data rows."""
+        raise NotImplementedError
+
+    def digest(self, shards: np.ndarray) -> np.ndarray:
+        """(B, n, L) u8 -> (B, n, 8) u32 phash256 digests."""
+        raise NotImplementedError
+
+    def verify(self, shards: np.ndarray, digests: np.ndarray) -> np.ndarray:
+        """(B, n, L) u8 + (B, n, 8) digests -> (B, n) bool intact mask."""
+        return (self.digest(shards) == np.asarray(digests)).all(axis=-1)
+
+
+class TpuBackend(CodecBackend):
+    name = "tpu"
+
+    def encode(self, data, parity_shards):
+        import jax.numpy as jnp
+
+        from ..ops import codec_step
+
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        B, k, L = data.shape
+        words = jnp.asarray(codec_step.host_bytes_to_words(data))
+        parity_w, digests = codec_step.encode_and_hash_words(
+            words, parity_shards, L
+        )
+        parity = codec_step.host_words_to_bytes(np.asarray(parity_w))
+        return parity, np.asarray(digests)
+
+    def reconstruct(self, shards, present, data_shards, parity_shards):
+        import jax.numpy as jnp
+
+        from ..ops import codec_step
+
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        words = jnp.asarray(codec_step.host_bytes_to_words(shards))
+        dw = codec_step.reconstruct_words_batch(
+            words, tuple(bool(b) for b in present), data_shards, parity_shards
+        )
+        return codec_step.host_words_to_bytes(np.asarray(dw))
+
+    def digest(self, shards):
+        import jax.numpy as jnp
+
+        from ..ops import codec_step
+
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        B, n, L = shards.shape
+        words = jnp.asarray(codec_step.host_bytes_to_words(shards))
+        got = phash.phash256_words_batched(words, L)
+        return np.asarray(got)
+
+
+class CpuBackend(CodecBackend):
+    name = "cpu"
+
+    def encode(self, data, parity_shards):
+        from ..utils import native
+
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        B, k, L = data.shape
+        m = parity_shards
+        parity = np.empty((B, m, L), dtype=np.uint8)
+        matrix = gf.parity_matrix(k, m)
+        for b in range(B):
+            parity[b] = native.gf_matmul_cpu(matrix, data[b])
+        digests = self.digest(
+            np.concatenate([data, parity], axis=1)
+        )
+        return parity, digests
+
+    def reconstruct(self, shards, present, data_shards, parity_shards):
+        from ..utils import native
+
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        B = shards.shape[0]
+        out = np.empty(
+            (B, data_shards, shards.shape[2]), dtype=np.uint8
+        )
+        pres = np.asarray(present, dtype=bool)
+        for b in range(B):
+            out[b] = native.reconstruct_cpu(
+                shards[b], pres, data_shards, parity_shards
+            )
+        return out
+
+    def digest(self, shards):
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        L = shards.shape[-1]
+        words = shards.view(np.uint32)
+        return phash.phash256_host_batched(words, L)
+
+
+_lock = threading.Lock()
+_backend: "CodecBackend | None" = None
+
+
+def get_backend(name: "str | None" = None) -> CodecBackend:
+    """Resolve the codec backend (MINIO_ERASURE_BACKEND=tpu|cpu|auto)."""
+    global _backend
+    if name is None:
+        with _lock:
+            if _backend is not None:
+                return _backend
+            name = os.environ.get("MINIO_ERASURE_BACKEND", "auto")
+            _backend = _make(name)
+            return _backend
+    return _make(name)
+
+
+def _make(name: str) -> CodecBackend:
+    if name == "cpu":
+        return CpuBackend()
+    if name == "tpu":
+        return TpuBackend()
+    if name == "auto":
+        try:
+            import jax
+
+            # any jax backend (tpu or the CPU test platform) works; the
+            # device path dispatches pallas-vs-portable internally
+            jax.devices()
+            return TpuBackend()
+        except Exception:
+            return CpuBackend()
+    raise ValueError(f"unknown erasure backend {name!r}")
+
+
+def reset_backend() -> None:
+    """Testing aid: drop the cached backend so env changes take effect."""
+    global _backend
+    with _lock:
+        _backend = None

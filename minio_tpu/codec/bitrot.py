@@ -1,0 +1,95 @@
+"""Bitrot protection: algorithm registry + streaming shard-file framing.
+
+Mirrors the reference's bitrot framework (cmd/bitrot.go:41-58 registry,
+cmd/bitrot-streaming.go interleaved framing) with a TPU-native default:
+
+* ``phash256`` (default): the parallel digest computed on-device in the
+  same fused pass as erasure encode (ops/hash.py).  Streaming algorithm -
+  one 32-byte digest is interleaved before every shard block:
+  ``[digest][block][digest][block]...`` exactly like
+  streamingBitrotWriter (bitrot-streaming.go:38-88).
+* ``sha256`` / ``blake2b512``: host hashlib algorithms, whole-file mode,
+  kept for parity with the reference registry (bitrot.go:24-39).
+
+Shard blocks are zero-padded to 32-byte multiples (device word/tile
+alignment); the pad is part of the hashed payload, and true lengths are
+recovered from object size metadata at decode.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..ops import hash as phash
+
+DIGEST_SIZE = 32
+ALIGN = 32  # shard blocks padded to this; also the digest frame size
+
+# registry: name -> (streaming?, factory for whole-file mode)
+_ALGORITHMS = {
+    "phash256": (True, None),
+    "sha256": (False, hashlib.sha256),
+    "blake2b512": (False, hashlib.blake2b),
+}
+
+DEFAULT_ALGORITHM = "phash256"
+
+
+def algorithms() -> list[str]:
+    return list(_ALGORITHMS)
+
+
+def is_streaming(name: str) -> bool:
+    try:
+        return _ALGORITHMS[name][0]
+    except KeyError:
+        raise ValueError(f"unknown bitrot algorithm {name!r}") from None
+
+
+def whole_file_digest(name: str, payload: bytes) -> bytes:
+    """Whole-file digest for non-streaming algorithms."""
+    streaming, factory = _ALGORITHMS[name]
+    if streaming:
+        return phash.phash256_host(payload)
+    return factory(payload).digest()
+
+
+def pad_block(data: bytes) -> bytes:
+    """Zero-pad a shard block to the device alignment."""
+    rem = len(data) % ALIGN
+    return data if rem == 0 else data + b"\0" * (ALIGN - rem)
+
+
+def padded_len(n: int) -> int:
+    return (n + ALIGN - 1) // ALIGN * ALIGN
+
+
+def frame_size(shard_block_len: int) -> int:
+    """Bytes one framed shard block occupies on disk (digest + padding).
+
+    The analogue of the per-block accounting in bitrotShardFileSize
+    (cmd/bitrot.go:140-145).
+    """
+    return DIGEST_SIZE + padded_len(shard_block_len)
+
+
+def digest_to_bytes(d: np.ndarray) -> bytes:
+    """(8,) uint32 device digest -> 32-byte frame."""
+    return np.ascontiguousarray(d, dtype=np.uint32).tobytes()
+
+
+def digest_from_bytes(b: bytes) -> np.ndarray:
+    if len(b) != DIGEST_SIZE:
+        raise ValueError(f"bad digest frame length {len(b)}")
+    return np.frombuffer(b, dtype=np.uint32).copy()
+
+
+def verify_block(payload: bytes, digest_frame: bytes) -> bool:
+    """Host-side single-block verification (tools, tests, heal spot checks).
+
+    The hot read path verifies in one batched device pass instead
+    (codec backend verify()).
+    """
+    return phash.phash256_host(payload) == digest_frame

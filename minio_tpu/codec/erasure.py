@@ -1,0 +1,379 @@
+"""Erasure: streaming shard-geometry wrapper over the codec backend.
+
+The counterpart of the reference's Erasure type (cmd/erasure-coding.go:28-143
+shard math, cmd/erasure-encode.go Encode, cmd/erasure-decode.go Decode,
+cmd/erasure-lowlevel-heal.go Heal) - redesigned around batched device
+passes instead of a per-block CPU loop:
+
+* The object stream is cut into ``block_size`` blocks (blockSizeV1 = 10 MiB
+  in the reference, cmd/object-api-common.go:31) and BATCHES of blocks are
+  encoded/hashed in one fused TPU pass (ops/codec_step), amortizing launch
+  overhead and keeping the device queue full - the design BASELINE.json
+  calls "erasure-sets.go coalesces shards into TPU-sized batches".
+* Shard files use the interleaved bitrot framing of bitrot-streaming.go:
+  [32B digest][shard block]... with blocks zero-padded to 32B (device
+  alignment); true lengths are recovered from the object size.
+
+Writers/readers are any objects with ``write(bytes) -> None`` /
+``read_at(offset, length) -> bytes`` (storage-layer bitrot streams); a
+None writer/reader is an offline disk, tolerated down to the quorum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from . import backend as backend_mod, bitrot
+
+BLOCK_SIZE_V1 = 10 * 1024 * 1024  # reference blockSizeV1
+DEFAULT_BATCH_BLOCKS = 4
+
+
+class ErasureError(Exception):
+    pass
+
+
+class QuorumError(ErasureError):
+    """Fewer healthy shards than required (errXLReadQuorum/WriteQuorum)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Erasure:
+    """Shard geometry + streaming codec ops for one erasure config."""
+
+    data_blocks: int
+    parity_blocks: int
+    block_size: int = BLOCK_SIZE_V1
+
+    def __post_init__(self):
+        if not (1 <= self.data_blocks <= 16):
+            raise ValueError(f"dataBlocks {self.data_blocks} out of range")
+        if not (0 <= self.parity_blocks <= 16):
+            raise ValueError(f"parityBlocks {self.parity_blocks} out of range")
+        if self.block_size <= 0:
+            raise ValueError("blockSize must be positive")
+
+    @property
+    def total_shards(self) -> int:
+        return self.data_blocks + self.parity_blocks
+
+    # ---- shard math (cmd/erasure-coding.go:115-143 semantics + padding) --
+
+    def shard_size(self, block_len: "int | None" = None) -> int:
+        """Unpadded shard length for one object block (ShardSize)."""
+        if block_len is None:
+            block_len = self.block_size
+        return -(-block_len // self.data_blocks)
+
+    def shard_size_padded(self, block_len: "int | None" = None) -> int:
+        """Device-aligned shard length actually encoded and stored."""
+        return bitrot.padded_len(self.shard_size(block_len))
+
+    def block_count(self, total_length: int) -> int:
+        if total_length == 0:
+            return 0
+        return -(-total_length // self.block_size)
+
+    def shard_file_size(self, total_length: int) -> int:
+        """On-disk framed size of each shard file (ShardFileSize)."""
+        if total_length < 0:
+            raise ValueError("negative length")
+        if total_length == 0:
+            return 0
+        full, last = divmod(total_length, self.block_size)
+        size = full * bitrot.frame_size(self.shard_size())
+        if last:
+            size += bitrot.frame_size(self.shard_size(last))
+        return size
+
+    def shard_block_offset(self, block_index: int) -> int:
+        """Framed offset of block_index within every shard file."""
+        return block_index * bitrot.frame_size(self.shard_size())
+
+    def shard_file_offset(
+        self, start_offset: int, length: int, total_length: int
+    ) -> int:
+        """Framed end-offset covering [start, start+length) (ShardFileOffset)."""
+        until = start_offset + length
+        return self.shard_file_size(min(until, total_length))
+
+    def _block_len(self, block_index: int, total_length: int) -> int:
+        start = block_index * self.block_size
+        return min(self.block_size, total_length - start)
+
+    # ---- streaming encode (cmd/erasure-encode.go:73-109) ----------------
+
+    def encode(
+        self,
+        reader,
+        writers: list,
+        write_quorum: int,
+        batch_blocks: int = DEFAULT_BATCH_BLOCKS,
+        backend: "backend_mod.CodecBackend | None" = None,
+    ) -> int:
+        """Stream from ``reader`` (has .read(n)) into framed shard writers.
+
+        Batches of blocks share one device pass.  Returns total bytes
+        consumed.  Raises QuorumError when healthy writers drop below
+        write_quorum (the parallelWriter quorum reduction,
+        erasure-encode.go:39-70).
+        """
+        be = backend or backend_mod.get_backend()
+        k, m = self.data_blocks, self.parity_blocks
+        total = 0
+        eof = False
+        while not eof:
+            blocks: list[bytes] = []
+            while len(blocks) < batch_blocks and not eof:
+                buf = _read_full(reader, self.block_size)
+                if not buf:
+                    eof = True
+                    break
+                if len(buf) < self.block_size:
+                    eof = True
+                blocks.append(buf)
+                total += len(buf)
+            if not blocks:
+                break
+            self._encode_batch(be, blocks, writers, write_quorum)
+        return total
+
+    def _encode_batch(self, be, blocks, writers, write_quorum) -> None:
+        k, m = self.data_blocks, self.parity_blocks
+        n = k + m
+        # uniform batch: all blocks but possibly the last share shard size
+        groups: list[tuple[int, list[bytes]]] = []
+        full = [b for b in blocks if len(b) == self.block_size]
+        tail = [b for b in blocks if len(b) != self.block_size]
+        if full:
+            groups.append((self.shard_size_padded(), full))
+        for b in tail:
+            groups.append((self.shard_size_padded(len(b)), [b]))
+        for shard_len, group in groups:
+            batch = np.zeros((len(group), k, shard_len), dtype=np.uint8)
+            for bi, block in enumerate(group):
+                ss = self.shard_size(len(block))
+                for s in range(k):
+                    chunk = block[s * ss : (s + 1) * ss]
+                    if chunk:
+                        batch[bi, s, : len(chunk)] = np.frombuffer(
+                            chunk, dtype=np.uint8
+                        )
+            parity, digests = be.encode(batch, m)
+            for bi in range(len(group)):
+                alive = 0
+                for s in range(n):
+                    w = writers[s] if s < len(writers) else None
+                    if w is None:
+                        continue
+                    payload = (
+                        batch[bi, s] if s < k else parity[bi, s - k]
+                    ).tobytes()
+                    frame = bitrot.digest_to_bytes(digests[bi, s])
+                    try:
+                        w.write(frame + payload)
+                        alive += 1
+                    except OSError:
+                        writers[s] = None
+                if alive < write_quorum:
+                    raise QuorumError(
+                        f"write quorum lost: {alive} < {write_quorum}"
+                    )
+
+    # ---- streaming decode (cmd/erasure-decode.go:211-290) ---------------
+
+    def decode(
+        self,
+        writer,
+        readers: list,
+        offset: int,
+        length: int,
+        total_length: int,
+        batch_blocks: int = DEFAULT_BATCH_BLOCKS,
+        backend: "backend_mod.CodecBackend | None" = None,
+    ) -> tuple[int, bool]:
+        """Reconstruct [offset, offset+length) into ``writer``.
+
+        Returns (bytes_written, heal_required): heal_required is set when
+        any shard was missing or failed bitrot verification but quorum
+        still allowed reconstruction (errHealRequired semantics,
+        erasure-decode.go:165-167).
+        """
+        if length == 0:
+            return 0, False
+        if offset < 0 or length < 0 or offset + length > total_length:
+            raise ValueError("range out of bounds")
+        be = backend or backend_mod.get_backend()
+        k = self.data_blocks
+        start_block = offset // self.block_size
+        end_block = (offset + length - 1) // self.block_size
+        written = 0
+        heal_required = False
+        bi = start_block
+        while bi <= end_block:
+            batch_idx = list(range(bi, min(bi + batch_blocks, end_block + 1)))
+            # group by shard size (tail block may differ)
+            datas, healed = self._decode_blocks(
+                be, readers, batch_idx, total_length
+            )
+            heal_required = heal_required or healed
+            for j, block_index in enumerate(batch_idx):
+                block_start = block_index * self.block_size
+                block_len = self._block_len(block_index, total_length)
+                lo = max(offset, block_start) - block_start
+                hi = min(offset + length, block_start + block_len) - block_start
+                if hi > lo:
+                    writer.write(datas[j][lo:hi])
+                    written += hi - lo
+            bi += len(batch_idx)
+        return written, heal_required
+
+    def _decode_blocks(
+        self, be, readers, block_indices: list[int], total_length: int
+    ) -> tuple[list[bytes], bool]:
+        """Read + verify + reconstruct a batch of blocks -> raw block bytes."""
+        k, m = self.data_blocks, self.parity_blocks
+        n = k + m
+        sizes = [
+            self.shard_size_padded(self._block_len(b, total_length))
+            for b in block_indices
+        ]
+        heal = False
+        out: list[bytes] = []
+        # group contiguous runs with equal shard size into one device pass
+        i = 0
+        while i < len(block_indices):
+            j = i
+            while j < len(block_indices) and sizes[j] == sizes[i]:
+                j += 1
+            group = block_indices[i:j]
+            shard_len = sizes[i]
+            shards = np.zeros((len(group), n, shard_len), dtype=np.uint8)
+            digests = np.zeros((len(group), n, 8), dtype=np.uint32)
+            present = np.zeros((len(group), n), dtype=bool)
+            for gi, b in enumerate(group):
+                off = self.shard_block_offset(b)
+                frame = bitrot.DIGEST_SIZE + shard_len
+                for s in range(n):
+                    r = readers[s] if s < len(readers) else None
+                    if r is None:
+                        continue
+                    try:
+                        buf = r.read_at(off, frame)
+                    except OSError:
+                        readers[s] = None
+                        continue
+                    if len(buf) != frame:
+                        continue
+                    digests[gi, s] = bitrot.digest_from_bytes(
+                        buf[: bitrot.DIGEST_SIZE]
+                    )
+                    shards[gi, s] = np.frombuffer(
+                        buf[bitrot.DIGEST_SIZE :], dtype=np.uint8
+                    )
+                    present[gi, s] = True
+            ok = be.verify(shards, digests) & present
+            if (ok != present).any():
+                heal = True  # bitrot detected somewhere
+            if (~present).any():
+                heal = heal or bool((~present).any(axis=1).any())
+            # reconstruct per distinct pattern (usually one)
+            datas = np.zeros((len(group), k, shard_len), dtype=np.uint8)
+            patterns: dict[tuple, list[int]] = {}
+            for gi in range(len(group)):
+                pat = tuple(bool(x) for x in ok[gi])
+                patterns.setdefault(pat, []).append(gi)
+            for pat, gis in patterns.items():
+                if sum(pat) < k:
+                    raise QuorumError(
+                        f"read quorum lost: {sum(pat)}/{n} shards intact,"
+                        f" need {k}"
+                    )
+                if all(pat[:k]):
+                    datas[gis] = shards[gis][:, :k]
+                else:
+                    datas[np.asarray(gis)] = be.reconstruct(
+                        shards[np.asarray(gis)], pat, k, m
+                    )
+            for gi, b in enumerate(group):
+                block_len = self._block_len(b, total_length)
+                ss = self.shard_size(block_len)
+                block = datas[gi, :, :ss].reshape(-1)[:block_len]
+                out.append(block.tobytes())
+            i = j
+        return out, heal
+
+    # ---- heal (cmd/erasure-lowlevel-heal.go:28-48) ----------------------
+
+    def heal(
+        self,
+        readers: list,
+        writers: list,
+        total_length: int,
+        backend: "backend_mod.CodecBackend | None" = None,
+    ) -> None:
+        """Rebuild missing shard files from survivors (quorum = k).
+
+        readers[i] is None for the outdated/offline disks; writers[i] is
+        non-None exactly where a shard must be rebuilt.  Streams
+        block-by-block: verify survivors, reconstruct all shards, re-frame
+        and write the ones needed.
+        """
+        be = backend or backend_mod.get_backend()
+        k, m = self.data_blocks, self.parity_blocks
+        n = k + m
+        for b in range(self.block_count(total_length)):
+            block_len = self._block_len(b, total_length)
+            shard_len = self.shard_size_padded(block_len)
+            frame = bitrot.DIGEST_SIZE + shard_len
+            off = self.shard_block_offset(b)
+            shards = np.zeros((1, n, shard_len), dtype=np.uint8)
+            digests = np.zeros((1, n, 8), dtype=np.uint32)
+            present = np.zeros(n, dtype=bool)
+            for s in range(n):
+                r = readers[s] if s < len(readers) else None
+                if r is None:
+                    continue
+                try:
+                    buf = r.read_at(off, frame)
+                except OSError:
+                    continue
+                if len(buf) != frame:
+                    continue
+                digests[0, s] = bitrot.digest_from_bytes(
+                    buf[: bitrot.DIGEST_SIZE]
+                )
+                shards[0, s] = np.frombuffer(
+                    buf[bitrot.DIGEST_SIZE :], dtype=np.uint8
+                )
+                present[s] = True
+            ok = (be.verify(shards, digests)[0]) & present
+            if ok.sum() < k:
+                raise QuorumError(
+                    f"heal: {int(ok.sum())}/{n} shards intact, need {k}"
+                )
+            pat = tuple(bool(x) for x in ok)
+            data = be.reconstruct(shards, pat, k, m)  # (1, k, L)
+            parity, new_digests = be.encode(data, m)
+            full = np.concatenate([data, parity], axis=1)[0]
+            for s in range(n):
+                w = writers[s] if s < len(writers) else None
+                if w is None:
+                    continue
+                frame_bytes = bitrot.digest_to_bytes(new_digests[0, s])
+                w.write(frame_bytes + full[s].tobytes())
+
+
+def _read_full(reader, size: int) -> bytes:
+    """Read exactly size bytes unless EOF (io.ReadFull semantics)."""
+    chunks = []
+    got = 0
+    while got < size:
+        chunk = reader.read(size - got)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)

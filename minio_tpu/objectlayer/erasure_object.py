@@ -1,0 +1,644 @@
+"""ErasureObjects: one erasure set of N disks (cmd/erasure-object.go).
+
+The core ObjectLayer: objects are striped across all disks of the set with
+parity, committed via per-disk staging + atomic rename, read back through
+metadata quorum + batched TPU decode.  Distribution, quorum and staging
+semantics follow the reference call stack (SURVEY.md section 3.2/3.3);
+the codec work itself is the batched device pass in codec/erasure.py.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from ..codec import erasure as ecodec
+from ..codec.erasure import Erasure, QuorumError
+from ..storage import errors as serrors
+from ..storage.meta import (
+    ErasureInfo,
+    FileInfo,
+    ObjectPartInfo,
+    new_version_id,
+    now_ns,
+)
+from ..utils.hashreader import HashReader
+from . import api
+from .api import (
+    BucketExists,
+    BucketInfo,
+    BucketNotEmpty,
+    BucketNotFound,
+    ListObjectsInfo,
+    ObjectInfo,
+    ObjectLayer,
+    ObjectNotFound,
+    ReadQuorumError,
+    WriteQuorumError,
+    check_bucket_name,
+    check_object_name,
+)
+from .metadata import (
+    find_fileinfo_in_quorum,
+    hash_order,
+    object_quorum_from_meta,
+    read_all_fileinfo,
+    reduce_errs,
+    shuffle_disks,
+)
+
+SYS_VOL = ".sys"
+
+
+class ErasureObjects(ObjectLayer):
+    """One erasure set over ``disks`` (offline entries are None)."""
+
+    def __init__(
+        self,
+        disks: list,
+        parity_blocks: "int | None" = None,
+        block_size: int = ecodec.BLOCK_SIZE_V1,
+        nslock=None,
+    ):
+        if len(disks) < 2:
+            raise ValueError("erasure set needs >= 2 disks")
+        self.disks = list(disks)
+        n = len(disks)
+        self.parity_blocks = (
+            parity_blocks if parity_blocks is not None else n // 2
+        )
+        self.data_blocks = n - self.parity_blocks
+        if self.parity_blocks > n // 2:
+            raise ValueError("parity cannot exceed half the disks")
+        self.block_size = block_size
+        from ..dsync.namespace import NamespaceLock
+
+        self.nslock = nslock or NamespaceLock()
+
+    # ------------------------------------------------------------------
+    # quorums (erasure-object.go:593-596)
+    # ------------------------------------------------------------------
+
+    @property
+    def read_quorum(self) -> int:
+        return self.data_blocks
+
+    @property
+    def write_quorum(self) -> int:
+        wq = self.data_blocks
+        if self.data_blocks == self.parity_blocks:
+            wq += 1
+        return wq
+
+    def _online_disks(self) -> list:
+        return [
+            d if (d is not None and d.is_online()) else None
+            for d in self.disks
+        ]
+
+    # ------------------------------------------------------------------
+    # buckets (cmd/erasure-bucket.go)
+    # ------------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        check_bucket_name(bucket)
+        errs = []
+        for d in self._online_disks():
+            if d is None:
+                errs.append(serrors.DiskNotFound("offline"))
+                continue
+            try:
+                d.make_vol(bucket)
+                errs.append(None)
+            except serrors.VolumeExists as e:
+                errs.append(e)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        if any(isinstance(e, serrors.VolumeExists) for e in errs):
+            raise BucketExists(bucket)
+        reduce_errs(errs, self.write_quorum, WriteQuorumError)
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        check_bucket_name(bucket)
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                vi = d.stat_vol(bucket)
+                return BucketInfo(vi.name, vi.created_ns)
+            except serrors.VolumeNotFound:
+                raise BucketNotFound(bucket) from None
+            except Exception:  # noqa: BLE001
+                continue
+        raise BucketNotFound(bucket)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                return [
+                    BucketInfo(v.name, v.created_ns)
+                    for v in d.list_vols()
+                ]
+            except Exception:  # noqa: BLE001
+                continue
+        return []
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self.get_bucket_info(bucket)  # existence check
+        errs = []
+        nonempty = False
+        for d in self._online_disks():
+            if d is None:
+                errs.append(serrors.DiskNotFound("offline"))
+                continue
+            try:
+                d.delete_vol(bucket, force=force)
+                errs.append(None)
+            except serrors.VolumeNotEmpty as e:
+                nonempty = True
+                errs.append(e)
+            except serrors.VolumeNotFound:
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        if nonempty:
+            raise BucketNotEmpty(bucket)
+        reduce_errs(errs, self.write_quorum, WriteQuorumError)
+
+    def _require_bucket(self, bucket: str) -> None:
+        self.get_bucket_info(bucket)
+
+    # ------------------------------------------------------------------
+    # put (erasure-object.go:570-765)
+    # ------------------------------------------------------------------
+
+    def put_object(
+        self, bucket, object_name, reader, size=-1, metadata=None
+    ) -> ObjectInfo:
+        check_object_name(object_name)
+        self._require_bucket(bucket)
+        with self.nslock.write(bucket, object_name):
+            return self._put_object(
+                bucket, object_name, reader, size, metadata
+            )
+
+    def _put_object(
+        self, bucket, object_name, reader, size, metadata
+    ) -> ObjectInfo:
+        k, m, n = self.data_blocks, self.parity_blocks, len(self.disks)
+        er = Erasure(k, m, self.block_size)
+        hreader = (
+            reader if isinstance(reader, HashReader) else HashReader(reader, size)
+        )
+        distribution = hash_order(f"{bucket}/{object_name}", n)
+        disks = shuffle_disks(self._online_disks(), distribution)
+
+        data_dir = uuid.uuid4().hex
+        tmp_ids = [uuid.uuid4().hex for _ in range(n)]
+        writers: list = []
+        for i, d in enumerate(disks):
+            if d is None:
+                writers.append(None)
+                continue
+            try:
+                writers.append(
+                    d.create_file(
+                        SYS_VOL, f"tmp/{tmp_ids[i]}/{data_dir}/part.1"
+                    )
+                )
+            except Exception:  # noqa: BLE001
+                writers.append(None)
+
+        try:
+            total = er.encode(hreader, writers, self.write_quorum)
+        except QuorumError as e:
+            self._cleanup_tmp(disks, tmp_ids)
+            raise WriteQuorumError(str(e)) from e
+        for w in writers:
+            if w is not None:
+                try:
+                    w.close()
+                except OSError:
+                    pass
+
+        mod_time = now_ns()
+        etag = hreader.etag()
+        meta = dict(metadata or {})
+        meta.setdefault("etag", etag)
+        # previous version's data dir (for overwrite cleanup)
+        old_data_dir = ""
+        try:
+            old_fi = self._read_quorum_fileinfo(bucket, object_name)[0]
+            old_data_dir = old_fi.data_dir
+        except Exception:  # noqa: BLE001
+            pass
+
+        errs = []
+        for i, d in enumerate(disks):
+            if d is None or writers[i] is None:
+                errs.append(serrors.DiskNotFound("offline"))
+                continue
+            fi = FileInfo(
+                volume=bucket,
+                name=object_name,
+                version_id="",
+                data_dir=data_dir,
+                size=total,
+                mod_time_ns=mod_time,
+                metadata=meta,
+                parts=[ObjectPartInfo(1, total, total)],
+                erasure=ErasureInfo(
+                    data_blocks=k,
+                    parity_blocks=m,
+                    block_size=self.block_size,
+                    index=i + 1,
+                    distribution=distribution,
+                ),
+            )
+            try:
+                d.rename_data(
+                    SYS_VOL, f"tmp/{tmp_ids[i]}", fi, bucket, object_name
+                )
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        try:
+            reduce_errs(errs, self.write_quorum, WriteQuorumError)
+        except WriteQuorumError:
+            self._cleanup_tmp(disks, tmp_ids)
+            raise
+        # overwrite cleanup: drop the replaced data dir (best effort)
+        if old_data_dir and old_data_dir != data_dir:
+            for d in disks:
+                if d is None:
+                    continue
+                try:
+                    d.delete_file(
+                        bucket,
+                        f"{object_name}/{old_data_dir}",
+                        recursive=True,
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+        return ObjectInfo(
+            bucket=bucket,
+            name=object_name,
+            size=total,
+            mod_time_ns=mod_time,
+            etag=etag,
+            content_type=meta.get("content-type", ""),
+            user_defined=meta,
+        )
+
+    def _cleanup_tmp(self, disks, tmp_ids) -> None:
+        for i, d in enumerate(disks):
+            if d is None:
+                continue
+            try:
+                d.delete_file(SYS_VOL, f"tmp/{tmp_ids[i]}", recursive=True)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------------
+    # get (erasure-object.go:141-331)
+    # ------------------------------------------------------------------
+
+    def _read_quorum_fileinfo(
+        self, bucket, object_name, version_id=""
+    ) -> tuple[FileInfo, list]:
+        disks = self._online_disks()
+        fis, errs = read_all_fileinfo(
+            disks, bucket, object_name, version_id
+        )
+        not_found = sum(
+            isinstance(e, (serrors.FileNotFound, serrors.VersionNotFound))
+            for e in errs
+        )
+        if not_found > len(self.disks) - self.read_quorum:
+            if version_id and any(
+                isinstance(e, serrors.VersionNotFound) for e in errs
+            ):
+                raise api.VersionNotFound(f"{bucket}/{object_name}")
+            raise ObjectNotFound(f"{bucket}/{object_name}")
+        fi = find_fileinfo_in_quorum(fis, self.read_quorum)
+        return fi, fis
+
+    def get_object_info(
+        self, bucket, object_name, version_id=""
+    ) -> ObjectInfo:
+        check_object_name(object_name)
+        self._require_bucket(bucket)
+        fi, _ = self._read_quorum_fileinfo(bucket, object_name, version_id)
+        if fi.deleted:
+            raise ObjectNotFound(f"{bucket}/{object_name}")
+        return self._to_object_info(bucket, object_name, fi)
+
+    @staticmethod
+    def _to_object_info(bucket, object_name, fi: FileInfo) -> ObjectInfo:
+        return ObjectInfo(
+            bucket=bucket,
+            name=object_name,
+            size=fi.size,
+            mod_time_ns=fi.mod_time_ns,
+            etag=fi.metadata.get("etag", ""),
+            content_type=fi.metadata.get("content-type", ""),
+            version_id=fi.version_id,
+            delete_marker=fi.deleted,
+            user_defined=dict(fi.metadata),
+            parts=list(fi.parts),
+        )
+
+    def get_object(
+        self, bucket, object_name, writer, offset=0, length=-1,
+        version_id="",
+    ) -> ObjectInfo:
+        check_object_name(object_name)
+        self._require_bucket(bucket)
+        with self.nslock.read(bucket, object_name):
+            fi, fis = self._read_quorum_fileinfo(
+                bucket, object_name, version_id
+            )
+            if fi.deleted:
+                raise ObjectNotFound(f"{bucket}/{object_name}")
+            if length < 0:
+                length = fi.size - offset
+            if offset < 0 or offset + length > fi.size:
+                raise api.InvalidRange(
+                    f"range {offset}+{length} of {fi.size}"
+                )
+            er = Erasure(
+                fi.erasure.data_blocks,
+                fi.erasure.parity_blocks,
+                fi.erasure.block_size,
+            )
+            disks = shuffle_disks(
+                self._online_disks(), fi.erasure.distribution
+            )
+            heal_required = False
+            # stream parts covering [offset, offset+length)
+            part_off = 0
+            remaining = length
+            cur = offset
+            for part in fi.parts:
+                part_start = part_off
+                part_end = part_off + part.size
+                part_off = part_end
+                if remaining <= 0 or part_end <= cur:
+                    continue
+                in_off = cur - part_start
+                in_len = min(part.size - in_off, remaining)
+                readers = self._part_readers(
+                    disks, bucket, object_name, fi, part.number
+                )
+                try:
+                    _, healed = er.decode(
+                        writer, readers, in_off, in_len, part.size
+                    )
+                except QuorumError as e:
+                    raise ReadQuorumError(str(e)) from e
+                finally:
+                    for r in readers:
+                        if r is not None:
+                            try:
+                                r.close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                heal_required = heal_required or healed
+                cur += in_len
+                remaining -= in_len
+            info = self._to_object_info(bucket, object_name, fi)
+            if heal_required:
+                info.user_defined["x-internal-heal-required"] = "true"
+            return info
+
+    def _part_readers(
+        self, disks, bucket, object_name, fi: FileInfo, part_number: int
+    ) -> list:
+        readers: list = []
+        for d in disks:
+            if d is None:
+                readers.append(None)
+                continue
+            try:
+                readers.append(
+                    d.read_file_stream(
+                        bucket,
+                        f"{object_name}/{fi.data_dir}/part.{part_number}",
+                    )
+                )
+            except Exception:  # noqa: BLE001
+                readers.append(None)
+        return readers
+
+    # ------------------------------------------------------------------
+    # delete (erasure-object.go:793+)
+    # ------------------------------------------------------------------
+
+    def delete_object(
+        self, bucket, object_name, version_id=""
+    ) -> ObjectInfo:
+        check_object_name(object_name)
+        self._require_bucket(bucket)
+        with self.nslock.write(bucket, object_name):
+            fi, _ = self._read_quorum_fileinfo(
+                bucket, object_name, version_id
+            )
+            errs = []
+            for d in self._online_disks():
+                if d is None:
+                    errs.append(serrors.DiskNotFound("offline"))
+                    continue
+                try:
+                    d.delete_file(bucket, object_name, recursive=True)
+                    errs.append(None)
+                except serrors.FileNotFound:
+                    errs.append(None)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            reduce_errs(errs, self.write_quorum, WriteQuorumError)
+            return ObjectInfo(
+                bucket=bucket, name=object_name, version_id=version_id
+            )
+
+    # ------------------------------------------------------------------
+    # copy
+    # ------------------------------------------------------------------
+
+    def copy_object(
+        self, src_bucket, src_object, dst_bucket, dst_object,
+        metadata=None,
+    ) -> ObjectInfo:
+        import io
+
+        src_info = self.get_object_info(src_bucket, src_object)
+        buf = io.BytesIO()
+        self.get_object(src_bucket, src_object, buf)
+        buf.seek(0)
+        meta = dict(src_info.user_defined)
+        if metadata:
+            meta.update(metadata)
+        meta.pop("etag", None)
+        return self.put_object(
+            dst_bucket, dst_object, buf, src_info.size, meta
+        )
+
+    # ------------------------------------------------------------------
+    # list (merged walk; cmd/erasure-sets.go listing semantics simplified)
+    # ------------------------------------------------------------------
+
+    def list_objects(
+        self, bucket, prefix="", marker="", delimiter="", max_keys=1000,
+    ) -> ListObjectsInfo:
+        self._require_bucket(bucket)
+        max_keys = max(0, min(max_keys, 1000))
+        names: set[str] = set()
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                names.update(d.walk(bucket))
+            except Exception:  # noqa: BLE001
+                continue
+        out = ListObjectsInfo()
+        seen_prefixes: set[str] = set()
+        count = 0
+        last_key = ""
+        for name in sorted(names):
+            if prefix and not name.startswith(prefix):
+                continue
+            if delimiter:
+                rest = name[len(prefix):]
+                di = rest.find(delimiter)
+                if di >= 0:
+                    cp = prefix + rest[: di + len(delimiter)]
+                    if cp <= marker:
+                        continue
+                    if cp not in seen_prefixes:
+                        if count >= max_keys:
+                            out.is_truncated = True
+                            out.next_marker = last_key
+                            break
+                        seen_prefixes.add(cp)
+                        out.prefixes.append(cp)
+                        count += 1
+                        last_key = cp
+                    continue
+            if marker and name <= marker:
+                continue
+            if count >= max_keys:
+                out.is_truncated = True
+                out.next_marker = last_key
+                break
+            try:
+                fi, _ = self._read_quorum_fileinfo(bucket, name)
+            except Exception:  # noqa: BLE001
+                continue
+            if fi.deleted:
+                continue
+            out.objects.append(self._to_object_info(bucket, name, fi))
+            count += 1
+            last_key = name
+        return out
+
+    # ------------------------------------------------------------------
+    # heal (erasure-healing.go:227 healObject)
+    # ------------------------------------------------------------------
+
+    def heal_object(
+        self, bucket, object_name, version_id="", dry_run=False
+    ) -> dict:
+        self._require_bucket(bucket)
+        with self.nslock.write(bucket, object_name):
+            disks_raw = self._online_disks()
+            fis, errs = read_all_fileinfo(
+                disks_raw, bucket, object_name, version_id
+            )
+            fi = find_fileinfo_in_quorum(fis, self.read_quorum)
+            disks = shuffle_disks(disks_raw, fi.erasure.distribution)
+            fis_shuffled = shuffle_disks(fis, fi.erasure.distribution)
+            er = Erasure(
+                fi.erasure.data_blocks,
+                fi.erasure.parity_blocks,
+                fi.erasure.block_size,
+            )
+            # classify disks: ok / outdated (disksWithAllParts semantics)
+            outdated: list[int] = []
+            for i, d in enumerate(disks):
+                f = fis_shuffled[i]
+                if d is None:
+                    continue  # offline: cannot heal
+                if (
+                    f is None
+                    or f.mod_time_ns != fi.mod_time_ns
+                    or f.data_dir != fi.data_dir
+                ):
+                    outdated.append(i)
+                    continue
+                try:
+                    d.verify_file(bucket, object_name, fi)
+                except Exception:  # noqa: BLE001
+                    outdated.append(i)
+            result = {
+                "bucket": bucket,
+                "object": object_name,
+                "disks": len(self.disks),
+                "outdated": list(outdated),
+                "healed": [],
+                "dry_run": dry_run,
+            }
+            if not outdated or dry_run:
+                return result
+            tmp_ids = {i: uuid.uuid4().hex for i in outdated}
+            for part in fi.parts:
+                readers = []
+                for i, d in enumerate(disks):
+                    if d is None or i in outdated:
+                        readers.append(None)
+                    else:
+                        try:
+                            readers.append(
+                                d.read_file_stream(
+                                    bucket,
+                                    f"{object_name}/{fi.data_dir}/part.{part.number}",
+                                )
+                            )
+                        except Exception:  # noqa: BLE001
+                            readers.append(None)
+                writers = [None] * len(disks)
+                for i in outdated:
+                    writers[i] = disks[i].create_file(
+                        SYS_VOL,
+                        f"tmp/{tmp_ids[i]}/{fi.data_dir}/part.{part.number}",
+                    )
+                try:
+                    er.heal(readers, writers, part.size)
+                except QuorumError as e:
+                    raise ReadQuorumError(str(e)) from e
+                finally:
+                    for r in readers:
+                        if r is not None:
+                            r.close()
+                    for w in writers:
+                        if w is not None:
+                            w.close()
+            for i in outdated:
+                hfi = FileInfo(**{**fi.__dict__})
+                hfi.erasure = ErasureInfo(**fi.erasure.__dict__)
+                hfi.erasure.index = i + 1
+                disks[i].rename_data(
+                    SYS_VOL, f"tmp/{tmp_ids[i]}", hfi, bucket, object_name
+                )
+                result["healed"].append(i)
+            return result
+
+    def storage_info(self) -> dict:
+        online = sum(d is not None for d in self._online_disks())
+        return {
+            "disks": len(self.disks),
+            "online": online,
+            "offline": len(self.disks) - online,
+            "data": self.data_blocks,
+            "parity": self.parity_blocks,
+        }

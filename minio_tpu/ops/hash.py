@@ -67,6 +67,31 @@ def _digest_np(words: np.ndarray, nbytes: int) -> np.ndarray:
     return out
 
 
+def phash256_host_batched(words: np.ndarray, nbytes: int) -> np.ndarray:
+    """Host digest over the last axis: (..., w) uint32 -> (..., 8) uint32.
+
+    Vectorized numpy twin of phash256_words_batched (bit-identical); used
+    by the CPU codec backend so host and device shard files interoperate.
+    """
+    n = words.shape[-1]
+    if n % _PARTS:
+        raise ValueError(f"word count {n} must be a multiple of {_PARTS}")
+    idx = np.arange(n, dtype=np.uint32)
+    key = _mix_np(idx * _C1 + np.uint32(1))
+    m1 = _mix_np((words ^ key) * _M1)
+    m2 = _mix_np((words + key) * _M2)
+    lead = words.shape[:-1]
+    p1 = np.bitwise_xor.reduce(
+        m1.reshape(*lead, n // _PARTS, _PARTS), axis=-2
+    )
+    p2 = np.bitwise_xor.reduce(
+        m2.reshape(*lead, n // _PARTS, _PARTS), axis=-2
+    )
+    out = np.concatenate([p1, p2], axis=-1)
+    lenmix = (np.uint64(nbytes) * np.uint64(_C1)).astype(np.uint32)
+    return _mix_np(out ^ lenmix + np.arange(8, dtype=np.uint32))
+
+
 def phash256_host(data: bytes | np.ndarray) -> bytes:
     """256-bit parallel bitrot digest of a byte string (host reference)."""
     buf = np.frombuffer(bytes(data), dtype=np.uint8)
