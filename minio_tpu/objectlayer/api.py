@@ -65,6 +65,10 @@ class InvalidPart(ObjectLayerError):
     pass
 
 
+class InvalidPartOrder(ObjectLayerError):
+    pass
+
+
 class PreconditionFailed(ObjectLayerError):
     pass
 

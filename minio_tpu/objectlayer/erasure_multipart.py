@@ -1,0 +1,426 @@
+"""Multipart uploads for the erasure object layer (cmd/erasure-multipart.go).
+
+Uploads are staged under the system volume:
+
+    .sys/multipart/<upload_id>/xl.meta      upload metadata (journal)
+    .sys/multipart/<upload_id>/part.N       framed erasure shards per part
+
+Each part is erasure-encoded independently with the object's distribution
+(deterministic from bucket/object, so every disk stages the shard it will
+eventually serve).  CompleteMultipartUpload renames the chosen part files
+into the final object data dir - no re-encoding, mirroring the
+rename-based commit of CompleteMultipartUpload (erasure-multipart.go:642).
+
+The multipart ETag is the S3 convention: md5(concat(part md5s)) + "-N".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+
+from ..codec.erasure import Erasure, QuorumError
+from ..storage import errors as serrors
+from ..storage.meta import (
+    ErasureInfo,
+    FileInfo,
+    ObjectPartInfo,
+    now_ns,
+)
+from ..utils.hashreader import HashReader
+from . import api
+from .api import (
+    CompletePart,
+    InvalidPart,
+    InvalidUploadID,
+    ObjectInfo,
+    PartInfo,
+    WriteQuorumError,
+    check_object_name,
+)
+from .metadata import (
+    find_fileinfo_in_quorum,
+    hash_order,
+    read_all_fileinfo,
+    reduce_errs,
+    shuffle_disks,
+)
+
+SYS_VOL = ".sys"
+MP_DIR = "multipart"
+
+
+class MultipartMixin:
+    """Multipart methods; mixed into ErasureObjects."""
+
+    # -- helpers ---------------------------------------------------------
+
+    def _mp_path(self, upload_id: str) -> str:
+        return f"{MP_DIR}/{upload_id}"
+
+    def _mp_read_meta(self, upload_id: str):
+        disks = self._online_disks()
+        fis, errs = read_all_fileinfo(
+            disks, SYS_VOL, self._mp_path(upload_id)
+        )
+        alive = sum(f is not None for f in fis)
+        if alive < self.read_quorum:
+            raise InvalidUploadID(upload_id)
+        return find_fileinfo_in_quorum(fis, self.read_quorum)
+
+    # -- API -------------------------------------------------------------
+
+    def new_multipart_upload(
+        self, bucket, object_name, metadata=None
+    ) -> str:
+        check_object_name(object_name)
+        self._require_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        meta = dict(metadata or {})
+        meta["x-internal-bucket"] = bucket
+        meta["x-internal-object"] = object_name
+        distribution = hash_order(
+            f"{bucket}/{object_name}", len(self.disks)
+        )
+        mod_time = now_ns()
+        errs = []
+        for i, d in enumerate(self._online_disks()):
+            if d is None:
+                errs.append(serrors.DiskNotFound("offline"))
+                continue
+            fi = FileInfo(
+                volume=SYS_VOL,
+                name=self._mp_path(upload_id),
+                data_dir="",
+                size=0,
+                mod_time_ns=mod_time,
+                metadata=meta,
+                erasure=ErasureInfo(
+                    data_blocks=self.data_blocks,
+                    parity_blocks=self.parity_blocks,
+                    block_size=self.block_size,
+                    index=i + 1,
+                    distribution=distribution,
+                ),
+            )
+            try:
+                d.write_metadata(SYS_VOL, self._mp_path(upload_id), fi)
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        reduce_errs(errs, self.write_quorum, WriteQuorumError)
+        return upload_id
+
+    def put_object_part(
+        self, bucket, object_name, upload_id, part_number, reader,
+        size=-1,
+    ) -> PartInfo:
+        if not (1 <= part_number <= 10000):
+            raise InvalidPart(f"part number {part_number}")
+        mfi = self._mp_read_meta(upload_id)
+        er = Erasure(
+            self.data_blocks, self.parity_blocks, self.block_size
+        )
+        hreader = HashReader(reader, size)
+        disks = shuffle_disks(
+            self._online_disks(), mfi.erasure.distribution
+        )
+        tmp_ids = [uuid.uuid4().hex for _ in disks]
+        writers: list = []
+        for i, d in enumerate(disks):
+            if d is None:
+                writers.append(None)
+                continue
+            try:
+                writers.append(
+                    d.create_file(
+                        SYS_VOL, f"tmp/{tmp_ids[i]}/part.{part_number}"
+                    )
+                )
+            except Exception:  # noqa: BLE001
+                writers.append(None)
+        try:
+            total = er.encode(hreader, writers, self.write_quorum)
+        except QuorumError as e:
+            self._cleanup_tmp(disks, tmp_ids)
+            raise WriteQuorumError(str(e)) from e
+        for w in writers:
+            if w is not None:
+                try:
+                    w.close()
+                except OSError:
+                    pass
+        etag = hreader.etag()
+        mod = now_ns()
+        # commit shard into the upload dir + record part metadata
+        errs = []
+        for i, d in enumerate(disks):
+            if d is None or writers[i] is None:
+                errs.append(serrors.DiskNotFound("offline"))
+                continue
+            try:
+                d.rename_file(
+                    SYS_VOL,
+                    f"tmp/{tmp_ids[i]}/part.{part_number}",
+                    SYS_VOL,
+                    f"{self._mp_path(upload_id)}/part.{part_number}",
+                )
+                d.write_all(
+                    SYS_VOL,
+                    f"{self._mp_path(upload_id)}/part.{part_number}.meta",
+                    f"{total}:{etag}:{mod}".encode(),
+                )
+                d.delete_file(SYS_VOL, f"tmp/{tmp_ids[i]}", recursive=True)
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        reduce_errs(errs, self.write_quorum, WriteQuorumError)
+        return PartInfo(
+            part_number=part_number,
+            etag=etag,
+            size=total,
+            actual_size=total,
+            mod_time_ns=mod,
+        )
+
+    def _read_part_meta(
+        self, upload_id: str, part_number: int
+    ) -> "tuple[int, str, int] | None":
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                raw = d.read_all(
+                    SYS_VOL,
+                    f"{self._mp_path(upload_id)}/part.{part_number}.meta",
+                ).decode()
+                size, etag, mod = raw.split(":")
+                return int(size), etag, int(mod)
+            except Exception:  # noqa: BLE001
+                continue
+        return None
+
+    def list_object_parts(
+        self, bucket, object_name, upload_id, part_marker=0,
+        max_parts=1000,
+    ) -> list[PartInfo]:
+        self._mp_read_meta(upload_id)
+        nums: set[int] = set()
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                for name in d.list_dir(SYS_VOL, self._mp_path(upload_id)):
+                    if name.startswith("part.") and name.endswith(".meta"):
+                        nums.add(int(name[5:-5]))
+            except Exception:  # noqa: BLE001
+                continue
+        out = []
+        for n in sorted(nums):
+            if n <= part_marker:
+                continue
+            pm = self._read_part_meta(upload_id, n)
+            if pm is None:
+                continue
+            size, etag, mod = pm
+            out.append(
+                PartInfo(n, etag, size, size, mod)
+            )
+            if len(out) >= max_parts:
+                break
+        return out
+
+    def list_multipart_uploads(
+        self, bucket, prefix=""
+    ) -> list[api.MultipartInfo]:
+        uploads = []
+        seen = set()
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                ids = d.list_dir(SYS_VOL, MP_DIR)
+            except Exception:  # noqa: BLE001
+                continue
+            for uid in ids:
+                uid = uid.rstrip("/")
+                if uid in seen:
+                    continue
+                seen.add(uid)
+                try:
+                    mfi = self._mp_read_meta(uid)
+                except Exception:  # noqa: BLE001
+                    continue
+                b = mfi.metadata.get("x-internal-bucket", "")
+                o = mfi.metadata.get("x-internal-object", "")
+                if b != bucket or (prefix and not o.startswith(prefix)):
+                    continue
+                uploads.append(
+                    api.MultipartInfo(b, o, uid, mfi.mod_time_ns)
+                )
+        uploads.sort(key=lambda u: (u.object, u.upload_id))
+        return uploads
+
+    def abort_multipart_upload(
+        self, bucket, object_name, upload_id
+    ) -> None:
+        self._mp_read_meta(upload_id)  # validates
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                d.delete_file(
+                    SYS_VOL, self._mp_path(upload_id), recursive=True
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    def complete_multipart_upload(
+        self, bucket, object_name, upload_id, parts: list[CompletePart],
+    ) -> ObjectInfo:
+        self._require_bucket(bucket)
+        mfi = self._mp_read_meta(upload_id)
+        # the upload id must belong to this bucket/object
+        # (CompleteMultipartUpload validates uploadID against the object,
+        # erasure-multipart.go:642)
+        if (
+            mfi.metadata.get("x-internal-bucket") != bucket
+            or mfi.metadata.get("x-internal-object") != object_name
+        ):
+            raise InvalidUploadID(upload_id)
+        if not parts:
+            raise InvalidPart("no parts")
+        # validate + collect part metadata
+        infos: list[tuple[CompletePart, int]] = []
+        md5s = hashlib.md5()
+        total = 0
+        last = 0
+        for cp in parts:
+            if cp.part_number <= last:
+                raise api.InvalidPartOrder("parts out of order")
+            last = cp.part_number
+            pm = self._read_part_meta(upload_id, cp.part_number)
+            if pm is None:
+                raise InvalidPart(f"part {cp.part_number} not found")
+            size, etag, _ = pm
+            if cp.etag and cp.etag.strip('"') != etag:
+                raise InvalidPart(f"part {cp.part_number} etag mismatch")
+            infos.append((cp, size))
+            md5s.update(bytes.fromhex(etag))
+            total += size
+        final_etag = f"{md5s.hexdigest()}-{len(parts)}"
+        mod_time = now_ns()
+        data_dir = uuid.uuid4().hex
+        distribution = mfi.erasure.distribution
+        disks = shuffle_disks(self._online_disks(), distribution)
+        meta = {
+            k: v
+            for k, v in mfi.metadata.items()
+            if not k.startswith("x-internal-")
+        }
+        meta["etag"] = final_etag
+
+        with self.nslock.write(bucket, object_name):
+            old_data_dir = ""
+            try:
+                old_fi = self._read_quorum_fileinfo(bucket, object_name)[0]
+                old_data_dir = old_fi.data_dir
+            except Exception:  # noqa: BLE001
+                pass
+            errs = []
+            staged: list[tuple] = []  # (disk, tmp) that moved parts out
+            for i, d in enumerate(disks):
+                if d is None:
+                    errs.append(serrors.DiskNotFound("offline"))
+                    continue
+                tmp = uuid.uuid4().hex
+                fi = FileInfo(
+                    volume=bucket,
+                    name=object_name,
+                    data_dir=data_dir,
+                    size=total,
+                    mod_time_ns=mod_time,
+                    metadata=meta,
+                    parts=[
+                        ObjectPartInfo(idx + 1, size, size)
+                        for idx, (cp, size) in enumerate(infos)
+                    ],
+                    erasure=ErasureInfo(
+                        data_blocks=self.data_blocks,
+                        parity_blocks=self.parity_blocks,
+                        block_size=self.block_size,
+                        index=i + 1,
+                        distribution=distribution,
+                    ),
+                )
+                try:
+                    # move chosen parts into the staged data dir,
+                    # renumbered consecutively (part.N -> part.idx+1)
+                    for idx, (cp, _size) in enumerate(infos):
+                        d.rename_file(
+                            SYS_VOL,
+                            f"{self._mp_path(upload_id)}/part.{cp.part_number}",
+                            SYS_VOL,
+                            f"tmp/{tmp}/{data_dir}/part.{idx + 1}",
+                        )
+                    staged.append((d, tmp))
+                    d.rename_data(
+                        SYS_VOL, f"tmp/{tmp}", fi, bucket, object_name
+                    )
+                    errs.append(None)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            try:
+                reduce_errs(errs, self.write_quorum, WriteQuorumError)
+            except WriteQuorumError:
+                # roll the staged parts back into the upload dir so the
+                # client can retry CompleteMultipartUpload
+                for d, tmp in staged:
+                    for idx, (cp, _size) in enumerate(infos):
+                        try:
+                            d.rename_file(
+                                SYS_VOL,
+                                f"tmp/{tmp}/{data_dir}/part.{idx + 1}",
+                                SYS_VOL,
+                                f"{self._mp_path(upload_id)}/part.{cp.part_number}",
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
+                    try:
+                        d.delete_file(
+                            SYS_VOL, f"tmp/{tmp}", recursive=True
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
+            if old_data_dir and old_data_dir != data_dir:
+                for d in disks:
+                    if d is None:
+                        continue
+                    try:
+                        d.delete_file(
+                            bucket,
+                            f"{object_name}/{old_data_dir}",
+                            recursive=True,
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+        # drop the upload dir
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                d.delete_file(
+                    SYS_VOL, self._mp_path(upload_id), recursive=True
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        return ObjectInfo(
+            bucket=bucket,
+            name=object_name,
+            size=total,
+            mod_time_ns=mod_time,
+            etag=final_etag,
+            content_type=meta.get("content-type", ""),
+            user_defined=meta,
+        )

@@ -50,7 +50,10 @@ from .metadata import (
 SYS_VOL = ".sys"
 
 
-class ErasureObjects(ObjectLayer):
+from .erasure_multipart import MultipartMixin
+
+
+class ErasureObjects(MultipartMixin, ObjectLayer):
     """One erasure set over ``disks`` (offline entries are None)."""
 
     def __init__(

@@ -1,0 +1,60 @@
+"""CLI entry: ``python -m minio_tpu.server [--address host:port] disk...``
+
+The `minio server` analogue (cmd/server-main.go): builds the object layer
+from disk paths (single path -> still erasure with minimum disks is not
+possible, so 1 path runs a 1-disk FS-style layout only when provided 1
+path; >=4 paths build one erasure set; sets/zones routing arrives with
+the distributed plane).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="minio-tpu server")
+    p.add_argument("disks", nargs="+", help="disk paths (>= 2)")
+    p.add_argument("--address", default="0.0.0.0:9000")
+    p.add_argument(
+        "--access-key",
+        default=os.environ.get("MINIO_ACCESS_KEY", "minioadmin"),
+    )
+    p.add_argument(
+        "--secret-key",
+        default=os.environ.get("MINIO_SECRET_KEY", "minioadmin"),
+    )
+    p.add_argument("--region", default="us-east-1")
+    args = p.parse_args(argv)
+
+    from ..objectlayer.erasure_object import ErasureObjects
+    from ..storage.xl import XLStorage
+    from .http import S3Server
+
+    if len(args.disks) < 2:
+        print("need at least 2 disk paths", file=sys.stderr)
+        return 2
+    disks = [XLStorage(d) for d in args.disks]
+    ol = ErasureObjects(disks)
+    srv = S3Server(
+        ol,
+        address=args.address,
+        access_key=args.access_key,
+        secret_key=args.secret_key,
+        region=args.region,
+    ).start()
+    print(
+        f"minio-tpu serving {len(disks)} disks "
+        f"(EC {ol.data_blocks}+{ol.parity_blocks}) at {srv.endpoint}"
+    )
+    stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
+    print(f"signal {stop}, shutting down")
+    srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,326 @@
+"""AWS Signature V4 verification (cmd/signature-v4.go).
+
+Supports header-based SigV4 (Authorization: AWS4-HMAC-SHA256 ...) and
+presigned URLs (X-Amz-Algorithm=AWS4-HMAC-SHA256 query auth,
+cmd/signature-v4.go doesPresignedSignatureMatch), with UNSIGNED-PAYLOAD
+and signed-payload content hashes.  SigV2 and streaming chunked signatures
+are recognized and rejected with a clear error until implemented.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.parse
+
+SIGN_V4_ALGORITHM = "AWS4-HMAC-SHA256"
+UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+PRESIGN_MAX_EXPIRES = 7 * 24 * 3600
+
+
+class AuthError(Exception):
+    """Maps to a specific S3 error code."""
+
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.code = code
+
+
+def _uri_encode(s: str, encode_slash: bool = True) -> str:
+    safe = "-._~" if encode_slash else "-._~/"
+    return urllib.parse.quote(s, safe=safe)
+
+
+def _canonical_query(query: "dict[str, list[str]]", skip=("X-Amz-Signature",)) -> str:
+    pairs = []
+    for k in sorted(query):
+        if k in skip:
+            continue
+        for v in sorted(query[k]):
+            pairs.append(f"{_uri_encode(k)}={_uri_encode(v)}")
+    return "&".join(pairs)
+
+
+def _signing_key(secret: str, date: str, region: str, service: str) -> bytes:
+    k = hmac.new(
+        ("AWS4" + secret).encode(), date.encode(), hashlib.sha256
+    ).digest()
+    for part in (region, service, "aws4_request"):
+        k = hmac.new(k, part.encode(), hashlib.sha256).digest()
+    return k
+
+
+def _hmac_hex(key: bytes, msg: str) -> str:
+    return hmac.new(key, msg.encode(), hashlib.sha256).hexdigest()
+
+
+def canonical_request(
+    method: str,
+    path: str,
+    query: "dict[str, list[str]]",
+    headers: "dict[str, str]",
+    signed_headers: list[str],
+    payload_hash: str,
+) -> str:
+    canon_headers = "".join(
+        f"{h}:{' '.join(headers.get(h, '').split())}\n"
+        for h in signed_headers
+    )
+    return "\n".join(
+        [
+            method.upper(),
+            _uri_encode(path, encode_slash=False) or "/",
+            _canonical_query(query),
+            canon_headers,
+            ";".join(signed_headers),
+            payload_hash,
+        ]
+    )
+
+
+def string_to_sign(amz_date: str, scope: str, creq: str) -> str:
+    return "\n".join(
+        [
+            SIGN_V4_ALGORITHM,
+            amz_date,
+            scope,
+            hashlib.sha256(creq.encode()).hexdigest(),
+        ]
+    )
+
+
+def sign_v4(
+    method: str,
+    path: str,
+    query: "dict[str, list[str]]",
+    headers: "dict[str, str]",
+    signed_headers: list[str],
+    payload_hash: str,
+    access_key: str,
+    secret_key: str,
+    amz_date: str,
+    region: str = "us-east-1",
+    service: str = "s3",
+) -> str:
+    """Compute the V4 signature (shared by verifier, clients, presigner)."""
+    date = amz_date[:8]
+    scope = f"{date}/{region}/{service}/aws4_request"
+    creq = canonical_request(
+        method, path, query, headers, signed_headers, payload_hash
+    )
+    sts = string_to_sign(amz_date, scope, creq)
+    key = _signing_key(secret_key, date, region, service)
+    return _hmac_hex(key, sts)
+
+
+class Credentials:
+    def __init__(self, access_key: str, secret_key: str):
+        self.access_key = access_key
+        self.secret_key = secret_key
+
+
+class SigV4Verifier:
+    """Verifies incoming requests against a credential lookup."""
+
+    def __init__(self, lookup, region: str = "us-east-1", clock=None):
+        """lookup(access_key) -> secret_key or None."""
+        self._lookup = lookup
+        self.region = region
+        self._clock = clock or (
+            lambda: datetime.datetime.now(datetime.timezone.utc)
+        )
+
+    # -- entry point -----------------------------------------------------
+
+    def verify(
+        self,
+        method: str,
+        path: str,
+        query: "dict[str, list[str]]",
+        headers: "dict[str, str]",
+        payload: bytes = b"",
+    ) -> str:
+        """Returns the authenticated access key; raises AuthError."""
+        headers = {k.lower(): v for k, v in headers.items()}
+        auth = headers.get("authorization", "")
+        if auth.startswith(SIGN_V4_ALGORITHM):
+            return self._verify_header(method, path, query, headers, payload)
+        if "X-Amz-Algorithm" in query:
+            return self._verify_presigned(method, path, query, headers)
+        if auth.startswith("AWS "):
+            raise AuthError(
+                "SignatureVersionNotSupported", "SigV2 not supported"
+            )
+        raise AuthError("AccessDenied", "no credentials provided")
+
+    # -- header auth -----------------------------------------------------
+
+    def _verify_header(self, method, path, query, headers, payload) -> str:
+        auth = headers["authorization"]
+        try:
+            rest = auth[len(SIGN_V4_ALGORITHM):].strip()
+            fields = dict(
+                kv.strip().split("=", 1) for kv in rest.split(",")
+            )
+            credential = fields["Credential"]
+            signed_headers = fields["SignedHeaders"].split(";")
+            got_sig = fields["Signature"]
+            access_key, date, region, service, term = (
+                credential.split("/", 4)
+            )
+        except (KeyError, ValueError):
+            raise AuthError(
+                "AuthorizationHeaderMalformed", auth
+            ) from None
+        if term != "aws4_request" or service != "s3":
+            raise AuthError("AuthorizationHeaderMalformed", credential)
+        if region != self.region:
+            raise AuthError(
+                "AuthorizationHeaderMalformed",
+                f"bad region {region}, expecting {self.region}",
+            )
+        secret = self._lookup(access_key)
+        if secret is None:
+            raise AuthError("InvalidAccessKeyId", access_key)
+        amz_date = headers.get("x-amz-date", "")
+        if not amz_date:
+            # SigV4 permits signing with the RFC1123 Date header; the
+            # string-to-sign timestamp is still ISO-basic
+            rfc_date = headers.get("date", "")
+            if not rfc_date:
+                raise AuthError("AccessDenied", "missing date")
+            import email.utils
+
+            try:
+                t = email.utils.parsedate_to_datetime(rfc_date)
+            except (TypeError, ValueError):
+                raise AuthError("MalformedDate", rfc_date) from None
+            if t is None:
+                raise AuthError("MalformedDate", rfc_date)
+            amz_date = t.astimezone(datetime.timezone.utc).strftime(
+                "%Y%m%dT%H%M%SZ"
+            )
+        self._check_skew(amz_date)
+        payload_hash = headers.get("x-amz-content-sha256", "")
+        if payload_hash.startswith("STREAMING-"):
+            raise AuthError(
+                "NotImplemented", "streaming signatures not supported yet"
+            )
+        if not payload_hash:
+            payload_hash = hashlib.sha256(payload).hexdigest()
+        elif payload_hash != UNSIGNED_PAYLOAD:
+            actual = hashlib.sha256(payload).hexdigest()
+            if actual != payload_hash:
+                raise AuthError(
+                    "XAmzContentSHA256Mismatch", "payload hash mismatch"
+                )
+        want = sign_v4(
+            method, path, query, headers, signed_headers, payload_hash,
+            access_key, secret, amz_date, region,
+        )
+        if not hmac.compare_digest(want, got_sig):
+            raise AuthError("SignatureDoesNotMatch", "")
+        return access_key
+
+    # -- presigned auth --------------------------------------------------
+
+    def _verify_presigned(self, method, path, query, headers) -> str:
+        q1 = {k: v[0] for k, v in query.items()}
+        if q1.get("X-Amz-Algorithm") != SIGN_V4_ALGORITHM:
+            raise AuthError("InvalidRequest", "bad algorithm")
+        try:
+            credential = q1["X-Amz-Credential"]
+            amz_date = q1["X-Amz-Date"]
+            expires = int(q1["X-Amz-Expires"])
+            signed_headers = q1["X-Amz-SignedHeaders"].split(";")
+            got_sig = q1["X-Amz-Signature"]
+            access_key, date, region, service, term = (
+                credential.split("/", 4)
+            )
+        except (KeyError, ValueError):
+            raise AuthError(
+                "AuthorizationQueryParametersError", ""
+            ) from None
+        if not (0 < expires <= PRESIGN_MAX_EXPIRES):
+            raise AuthError(
+                "AuthorizationQueryParametersError", "bad expires"
+            )
+        secret = self._lookup(access_key)
+        if secret is None:
+            raise AuthError("InvalidAccessKeyId", access_key)
+        # expiry check
+        try:
+            t0 = datetime.datetime.strptime(
+                amz_date, "%Y%m%dT%H%M%SZ"
+            ).replace(tzinfo=datetime.timezone.utc)
+        except ValueError:
+            raise AuthError("MalformedDate", amz_date) from None
+        now = self._clock()
+        if now < t0 - datetime.timedelta(minutes=15):
+            raise AuthError("RequestNotReadyYet", "")
+        if now > t0 + datetime.timedelta(seconds=expires):
+            raise AuthError("ExpiredToken", "presigned URL expired")
+        payload_hash = q1.get("X-Amz-Content-Sha256", UNSIGNED_PAYLOAD)
+        want = sign_v4(
+            method, path, query, headers, signed_headers, payload_hash,
+            access_key, secret, amz_date, region,
+        )
+        if not hmac.compare_digest(want, got_sig):
+            raise AuthError("SignatureDoesNotMatch", "")
+        return access_key
+
+    def _check_skew(self, amz_date: str) -> None:
+        try:
+            t = datetime.datetime.strptime(
+                amz_date, "%Y%m%dT%H%M%SZ"
+            ).replace(tzinfo=datetime.timezone.utc)
+        except ValueError:
+            raise AuthError("MalformedDate", amz_date) from None
+        skew = abs((self._clock() - t).total_seconds())
+        if skew > 15 * 60:
+            raise AuthError(
+                "RequestTimeTooSkewed", f"skew {int(skew)}s"
+            )
+
+
+def presign_url(
+    method: str,
+    url: str,
+    access_key: str,
+    secret_key: str,
+    expires: int = 3600,
+    region: str = "us-east-1",
+    amz_date: "str | None" = None,
+) -> str:
+    """Generate a presigned URL (client-side helper, web handlers)."""
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.netloc
+    if amz_date is None:
+        amz_date = datetime.datetime.now(
+            datetime.timezone.utc
+        ).strftime("%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+    query.update(
+        {
+            "X-Amz-Algorithm": [SIGN_V4_ALGORITHM],
+            "X-Amz-Credential": [
+                f"{access_key}/{date}/{region}/s3/aws4_request"
+            ],
+            "X-Amz-Date": [amz_date],
+            "X-Amz-Expires": [str(expires)],
+            "X-Amz-SignedHeaders": ["host"],
+        }
+    )
+    sig = sign_v4(
+        method, parsed.path or "/", query, {"host": host}, ["host"],
+        UNSIGNED_PAYLOAD, access_key, secret_key, amz_date, region,
+    )
+    query["X-Amz-Signature"] = [sig]
+    qs = urllib.parse.urlencode(query, doseq=True, quote_via=urllib.parse.quote)
+    return urllib.parse.urlunsplit(
+        (parsed.scheme, parsed.netloc, parsed.path, qs, "")
+    )

@@ -1,0 +1,580 @@
+"""The S3 HTTP server: router + handlers (L6/L7 of the layer map).
+
+One threaded stdlib HTTP server hosting the S3 API surface
+(cmd/api-router.go routes + cmd/object-handlers.go / bucket-handlers.go
+glue).  Requests are authenticated with SigV4 (auth.py), dispatched on
+(method, path-shape, query), and translated to ObjectLayer calls; errors
+render as S3 XML (s3errors.py / response.py).
+
+The reference funnels every handler through middleware
+(maxClients(collectAPIStats(httpTrace(...))), api-router.go:94); here the
+equivalent cross-cutting layer lives in _Handler.route(): auth, tracing
+hooks, error rendering, request IDs.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import email.utils
+import hashlib
+import io
+import os
+import socket
+import threading
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..objectlayer.api import CompletePart, ObjectInfo
+from ..utils.hashreader import HashReader
+from . import response as xmlr, s3errors
+from .auth import AuthError, Credentials, SigV4Verifier
+from .s3errors import S3Error
+
+MAX_IN_MEMORY_BODY = 1 << 30  # single-PUT cap; larger objects use multipart
+
+
+class S3Server:
+    """Owns the listener + object layer; one per process (xhttp.NewServer
+    analogue, cmd/http/server.go:185)."""
+
+    def __init__(
+        self,
+        object_layer,
+        address: str = "127.0.0.1:9000",
+        access_key: str = "minioadmin",
+        secret_key: str = "minioadmin",
+        region: str = "us-east-1",
+        iam=None,
+    ):
+        self.object_layer = object_layer
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.region = region
+        self.iam = iam
+        if iam is not None:
+            lookup = iam.lookup_secret
+        else:
+            creds = Credentials(access_key, secret_key)
+            lookup = (
+                lambda ak: creds.secret_key
+                if ak == creds.access_key
+                else None
+            )
+        self.verifier = SigV4Verifier(lookup, region)
+        self._httpd: "ThreadingHTTPServer | None" = None
+        self._thread: "threading.Thread | None" = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "S3Server":
+        server = self
+
+        class Handler(_Handler):
+            s3 = server
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), Handler
+        )
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="s3-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    s3: S3Server = None  # injected subclass attribute
+
+    # silence default stderr logging
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _parse(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        path = urllib.parse.unquote(parsed.path)
+        query = urllib.parse.parse_qs(
+            parsed.query, keep_blank_values=True
+        )
+        return path, query
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > MAX_IN_MEMORY_BODY:
+            # reject without reading: the unread bytes would desync this
+            # keep-alive connection, so force it closed
+            self.close_connection = True
+            raise S3Error("EntityTooLarge")
+        if length:
+            body = self.rfile.read(length)
+        else:
+            body = b""
+        self._body_consumed = True
+        return body
+
+    def _respond(
+        self,
+        status: int,
+        body: bytes = b"",
+        headers: "dict | None" = None,
+        content_type: str = "application/xml",
+    ):
+        self.send_response(status)
+        self.send_header("Server", "MinIO-TPU")
+        self.send_header(
+            "x-amz-request-id", uuid.uuid4().hex[:16].upper()
+        )
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        if body or status not in (204, 304):
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+        else:
+            self.send_header("Content-Length", "0")
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _error(self, err: s3errors.APIError, resource: str):
+        if err.status == 304:  # Not Modified carries no body
+            self._respond(304)
+            return
+        body = xmlr.error_xml(
+            err.code, err.message, resource, uuid.uuid4().hex[:16]
+        )
+        self._respond(err.status, body)
+
+    # -- entry ------------------------------------------------------------
+
+    def route(self):
+        path, query = self._parse()
+        self._body_consumed = False
+        try:
+            body = self._read_body()
+            # authenticate (setAuthHandler / checkRequestAuthType)
+            self.s3.verifier.verify(
+                self.command,
+                path,
+                query,
+                dict(self.headers.items()),
+                body,
+            )
+            self._dispatch(path, query, body)
+        except Exception as e:  # noqa: BLE001
+            if not self._body_consumed:
+                self.close_connection = True
+            self._error(s3errors.from_exception(e), path)
+
+    do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = route
+
+    # -- dispatch (api-router.go route table) -----------------------------
+
+    def _dispatch(self, path: str, query, body: bytes):
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        m = self.command
+        ol = self.s3.object_layer
+
+        if not bucket:
+            if m == "GET":
+                return self._list_buckets()
+            raise S3Error("MethodNotAllowed")
+
+        if key:
+            if m == "GET":
+                if "uploadId" in query:
+                    return self._list_parts(bucket, key, query)
+                return self._get_object(bucket, key, query)
+            if m == "HEAD":
+                return self._head_object(bucket, key, query)
+            if m == "PUT":
+                if "partNumber" in query and "uploadId" in query:
+                    return self._put_part(bucket, key, query, body)
+                if "x-amz-copy-source" in self.headers:
+                    return self._copy_object(bucket, key)
+                return self._put_object(bucket, key, body)
+            if m == "POST":
+                if "uploads" in query:
+                    return self._initiate_multipart(bucket, key)
+                if "uploadId" in query:
+                    return self._complete_multipart(
+                        bucket, key, query, body
+                    )
+            if m == "DELETE":
+                if "uploadId" in query:
+                    return self._abort_multipart(bucket, key, query)
+                return self._delete_object(bucket, key, query)
+            raise S3Error("MethodNotAllowed")
+
+        # bucket-level
+        if m == "GET":
+            if "location" in query:
+                return self._respond(200, xmlr.location_xml(""))
+            if "uploads" in query:
+                return self._list_uploads(bucket, query)
+            if "versioning" in query:
+                return self._respond(
+                    200,
+                    b'<?xml version="1.0" encoding="UTF-8"?>\n'
+                    b'<VersioningConfiguration xmlns="'
+                    + xmlr.S3_NS.encode()
+                    + b'"/>',
+                )
+            return self._list_objects(bucket, query)
+        if m == "HEAD":
+            ol.get_bucket_info(bucket)
+            return self._respond(200)
+        if m == "PUT":
+            ol.make_bucket(bucket)
+            return self._respond(200, headers={"Location": f"/{bucket}"})
+        if m == "DELETE":
+            ol.delete_bucket(bucket)
+            return self._respond(204)
+        if m == "POST":
+            if "delete" in query:
+                return self._delete_multiple(bucket, body)
+        raise S3Error("MethodNotAllowed")
+
+    # -- service ----------------------------------------------------------
+
+    def _list_buckets(self):
+        buckets = self.s3.object_layer.list_buckets()
+        self._respond(200, xmlr.list_buckets_xml(buckets))
+
+    # -- bucket ops -------------------------------------------------------
+
+    def _list_objects(self, bucket: str, query):
+        q1 = {k: v[0] for k, v in query.items()}
+        try:
+            max_keys = int(q1.get("max-keys", 1000))
+        except ValueError:
+            raise S3Error("InvalidArgument", "max-keys") from None
+        if max_keys < 0:
+            raise S3Error("InvalidArgument", "max-keys negative")
+        prefix = q1.get("prefix", "")
+        delimiter = q1.get("delimiter", "")
+        encode = q1.get("encoding-type", "") == "url"
+        if q1.get("list-type") == "2":
+            token = q1.get("continuation-token", "")
+            start_after = q1.get("start-after", "")
+            try:
+                marker = (
+                    base64.urlsafe_b64decode(token.encode()).decode()
+                    if token
+                    else start_after
+                )
+            except Exception:  # noqa: BLE001
+                raise S3Error(
+                    "InvalidArgument", "continuation-token"
+                ) from None
+            res = self.s3.object_layer.list_objects(
+                bucket, prefix, marker, delimiter, max_keys
+            )
+            body = xmlr.list_objects_v2_xml(
+                bucket, prefix, delimiter, max_keys, start_after,
+                token, res, encode,
+            )
+        else:
+            marker = q1.get("marker", "")
+            res = self.s3.object_layer.list_objects(
+                bucket, prefix, marker, delimiter, max_keys
+            )
+            body = xmlr.list_objects_v1_xml(
+                bucket, prefix, marker, delimiter, max_keys, res, encode
+            )
+        self._respond(200, body)
+
+    def _delete_multiple(self, bucket: str, body: bytes):
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML") from None
+        ns = ""
+        if root.tag.startswith("{"):
+            ns = root.tag[: root.tag.index("}") + 1]
+        quiet = (root.findtext(f"{ns}Quiet") or "").lower() == "true"
+        deleted, errs = [], []
+        for obj in root.findall(f"{ns}Object"):
+            key = obj.findtext(f"{ns}Key") or ""
+            try:
+                self.s3.object_layer.delete_object(bucket, key)
+                if not quiet:
+                    deleted.append(key)
+            except Exception as e:  # noqa: BLE001
+                err = s3errors.from_exception(e)
+                if err.code == "NoSuchKey":
+                    if not quiet:
+                        deleted.append(key)  # S3 treats as success
+                else:
+                    errs.append((key, err.code, err.message))
+        self._respond(200, xmlr.delete_result_xml(deleted, errs))
+
+    # -- object ops -------------------------------------------------------
+
+    def _object_headers(self, info: ObjectInfo) -> dict:
+        h = {
+            "ETag": f'"{info.etag}"',
+            "Last-Modified": email.utils.formatdate(
+                info.mod_time, usegmt=True
+            ),
+            "Accept-Ranges": "bytes",
+        }
+        if info.content_type:
+            h["Content-Type-Override"] = info.content_type
+        for k, v in info.user_defined.items():
+            if k.startswith("x-amz-meta-"):
+                h[k] = v
+        if info.version_id:
+            h["x-amz-version-id"] = info.version_id
+        return h
+
+    def _check_conditions(self, info: ObjectInfo):
+        """Conditional header evaluation (object-handlers-common.go)."""
+        inm = self.headers.get("If-None-Match")
+        im = self.headers.get("If-Match")
+        ims = self.headers.get("If-Modified-Since")
+        ius = self.headers.get("If-Unmodified-Since")
+        etag = f'"{info.etag}"'
+        if im and im not in (etag, "*", info.etag):
+            raise S3Error("PreconditionFailed")
+        if inm and inm in (etag, "*", info.etag):
+            raise S3Error("NotModified")
+        if ims:
+            t = email.utils.parsedate_to_datetime(ims)
+            if t and info.mod_time <= t.timestamp():
+                raise S3Error("NotModified")
+        if ius:
+            t = email.utils.parsedate_to_datetime(ius)
+            if t and info.mod_time > t.timestamp():
+                raise S3Error("PreconditionFailed")
+
+    def _parse_range(self, total: int) -> "tuple[int, int] | None":
+        """Parse Range: bytes=a-b (httprange.go)."""
+        hdr = self.headers.get("Range")
+        if not hdr:
+            return None
+        if not hdr.startswith("bytes="):
+            return None  # ignored per RFC
+        spec = hdr[len("bytes=") :]
+        if "," in spec:
+            raise S3Error("NotImplemented", "multiple ranges")
+        lo_s, _, hi_s = spec.partition("-")
+        try:
+            if lo_s == "":
+                # suffix range
+                n = int(hi_s)
+                if n == 0:
+                    raise S3Error("InvalidRange")
+                lo = max(0, total - n)
+                hi = total - 1
+            else:
+                lo = int(lo_s)
+                hi = int(hi_s) if hi_s else total - 1
+        except ValueError:
+            raise S3Error("InvalidRange") from None
+        if lo > hi or lo >= total:
+            raise S3Error("InvalidRange")
+        return lo, min(hi, total - 1)
+
+    def _get_object(self, bucket, key, query):
+        """Stream the object body straight to the socket: headers go out
+        first (size known from metadata), then the erasure decode writes
+        block-by-block into wfile - constant memory per request."""
+        ol = self.s3.object_layer
+        version_id = query.get("versionId", [""])[0]
+        info = ol.get_object_info(bucket, key, version_id)
+        self._check_conditions(info)
+        rng = self._parse_range(info.size)
+        headers = self._object_headers(info)
+        headers.pop("Content-Type-Override", None)
+        ct = info.content_type or "application/octet-stream"
+        if rng:
+            lo, hi = rng
+            status, length = 206, hi - lo + 1
+            headers["Content-Range"] = f"bytes {lo}-{hi}/{info.size}"
+        else:
+            status, length = 200, info.size
+            lo = 0
+        self.send_response(status)
+        self.send_header("Server", "MinIO-TPU")
+        self.send_header(
+            "x-amz-request-id", uuid.uuid4().hex[:16].upper()
+        )
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Type", ct)
+        self.send_header("Content-Length", str(length))
+        self.end_headers()
+        if length == 0:
+            return
+        try:
+            ol.get_object(
+                bucket, key, self.wfile, lo, length, version_id
+            )
+        except Exception:  # noqa: BLE001
+            # headers already sent; the only honest signal is a broken
+            # connection (the reference behaves the same mid-stream)
+            self.close_connection = True
+            raise ConnectionError("mid-stream decode failure") from None
+
+    def _head_object(self, bucket, key, query):
+        version_id = query.get("versionId", [""])[0]
+        info = self.s3.object_layer.get_object_info(
+            bucket, key, version_id
+        )
+        self._check_conditions(info)
+        headers = self._object_headers(info)
+        headers.pop("Content-Type-Override", None)
+        self.send_response(200)
+        self.send_header("Server", "MinIO-TPU")
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header(
+            "Content-Type",
+            info.content_type or "application/octet-stream",
+        )
+        self.send_header("Content-Length", str(info.size))
+        self.end_headers()
+
+    def _collect_user_metadata(self) -> dict:
+        meta = {}
+        ct = self.headers.get("Content-Type")
+        if ct:
+            meta["content-type"] = ct
+        for k, v in self.headers.items():
+            lk = k.lower()
+            if lk.startswith("x-amz-meta-"):
+                meta[lk] = v
+        return meta
+
+    def _put_object(self, bucket, key, body: bytes):
+        md5_hdr = self.headers.get("Content-MD5", "")
+        md5_hex = ""
+        if md5_hdr:
+            try:
+                md5_hex = base64.b64decode(md5_hdr).hex()
+            except Exception:  # noqa: BLE001
+                raise S3Error("InvalidDigest") from None
+        reader = HashReader(
+            io.BytesIO(body), len(body), md5_hex=md5_hex
+        )
+        info = self.s3.object_layer.put_object(
+            bucket, key, reader, len(body), self._collect_user_metadata()
+        )
+        self._respond(200, b"", {"ETag": f'"{info.etag}"'})
+
+    def _copy_object(self, bucket, key):
+        src = urllib.parse.unquote(
+            self.headers["x-amz-copy-source"]
+        ).lstrip("/")
+        if "/" not in src:
+            raise S3Error("InvalidArgument", "bad copy source")
+        src_bucket, src_key = src.split("/", 1)
+        directive = self.headers.get(
+            "x-amz-metadata-directive", "COPY"
+        )
+        meta = (
+            self._collect_user_metadata()
+            if directive == "REPLACE"
+            else None
+        )
+        info = self.s3.object_layer.copy_object(
+            src_bucket, src_key, bucket, key, meta
+        )
+        self._respond(
+            200, xmlr.copy_object_xml(info.etag, info.mod_time_ns)
+        )
+
+    def _delete_object(self, bucket, key, query):
+        version_id = query.get("versionId", [""])[0]
+        try:
+            self.s3.object_layer.delete_object(bucket, key, version_id)
+        except Exception as e:  # noqa: BLE001
+            err = s3errors.from_exception(e)
+            if err.code != "NoSuchKey":
+                raise
+        self._respond(204)
+
+    # -- multipart --------------------------------------------------------
+
+    def _initiate_multipart(self, bucket, key):
+        uid = self.s3.object_layer.new_multipart_upload(
+            bucket, key, self._collect_user_metadata()
+        )
+        self._respond(
+            200, xmlr.initiate_multipart_xml(bucket, key, uid)
+        )
+
+    def _put_part(self, bucket, key, query, body):
+        uid = query["uploadId"][0]
+        try:
+            pnum = int(query["partNumber"][0])
+        except ValueError:
+            raise S3Error("InvalidArgument", "partNumber") from None
+        pi = self.s3.object_layer.put_object_part(
+            bucket, key, uid, pnum, io.BytesIO(body), len(body)
+        )
+        self._respond(200, b"", {"ETag": f'"{pi.etag}"'})
+
+    def _complete_multipart(self, bucket, key, query, body):
+        uid = query["uploadId"][0]
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML") from None
+        ns = root.tag[: root.tag.index("}") + 1] if root.tag.startswith("{") else ""
+        parts = []
+        for pe in root.findall(f"{ns}Part"):
+            parts.append(
+                CompletePart(
+                    int(pe.findtext(f"{ns}PartNumber")),
+                    (pe.findtext(f"{ns}ETag") or "").strip('"'),
+                )
+            )
+        info = self.s3.object_layer.complete_multipart_upload(
+            bucket, key, uid, parts
+        )
+        self._respond(
+            200,
+            xmlr.complete_multipart_xml(
+                f"{self.s3.endpoint}/{bucket}/{key}",
+                bucket,
+                key,
+                info.etag,
+            ),
+        )
+
+    def _abort_multipart(self, bucket, key, query):
+        uid = query["uploadId"][0]
+        self.s3.object_layer.abort_multipart_upload(bucket, key, uid)
+        self._respond(204)
+
+    def _list_parts(self, bucket, key, query):
+        uid = query["uploadId"][0]
+        parts = self.s3.object_layer.list_object_parts(bucket, key, uid)
+        self._respond(
+            200, xmlr.list_parts_xml(bucket, key, uid, parts)
+        )
+
+    def _list_uploads(self, bucket, query):
+        prefix = query.get("prefix", [""])[0]
+        ups = self.s3.object_layer.list_multipart_uploads(bucket, prefix)
+        self._respond(200, xmlr.list_uploads_xml(bucket, ups))

@@ -1,0 +1,108 @@
+"""S3 API error model (cmd/api-errors.go, 2102 lines in the reference).
+
+Each error code carries its HTTP status and default message; exceptions
+from lower layers map onto codes via ``from_exception`` (the toAPIError
+translation, api-errors.go:1763).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from http import HTTPStatus as H
+
+from ..objectlayer import api as olapi
+from ..storage import errors as serrors
+from ..utils.hashreader import BadDigest
+from .auth import AuthError
+
+
+@dataclasses.dataclass(frozen=True)
+class APIError:
+    code: str
+    message: str
+    status: int
+
+
+_E = {
+    "AccessDenied": ("Access Denied.", H.FORBIDDEN),
+    "BadDigest": ("The Content-Md5 you specified did not match what we received.", H.BAD_REQUEST),
+    "BucketAlreadyExists": ("The requested bucket name is not available.", H.CONFLICT),
+    "BucketAlreadyOwnedByYou": ("Your previous request to create the named bucket succeeded and you already own it.", H.CONFLICT),
+    "BucketNotEmpty": ("The bucket you tried to delete is not empty.", H.CONFLICT),
+    "EntityTooLarge": ("Your proposed upload exceeds the maximum allowed object size.", H.BAD_REQUEST),
+    "EntityTooSmall": ("Your proposed upload is smaller than the minimum allowed object size.", H.BAD_REQUEST),
+    "ExpiredToken": ("The provided token has expired.", H.BAD_REQUEST),
+    "IncompleteBody": ("You did not provide the number of bytes specified by the Content-Length HTTP header.", H.BAD_REQUEST),
+    "InternalError": ("We encountered an internal error, please try again.", H.INTERNAL_SERVER_ERROR),
+    "InvalidAccessKeyId": ("The Access Key Id you provided does not exist in our records.", H.FORBIDDEN),
+    "InvalidArgument": ("Invalid Argument", H.BAD_REQUEST),
+    "InvalidBucketName": ("The specified bucket is not valid.", H.BAD_REQUEST),
+    "InvalidDigest": ("The Content-Md5 you specified is not valid.", H.BAD_REQUEST),
+    "InvalidPart": ("One or more of the specified parts could not be found.", H.BAD_REQUEST),
+    "InvalidPartOrder": ("The list of parts was not in ascending order.", H.BAD_REQUEST),
+    "InvalidRange": ("The requested range is not satisfiable", H.REQUESTED_RANGE_NOT_SATISFIABLE),
+    "InvalidRequest": ("Invalid Request", H.BAD_REQUEST),
+    "KeyTooLongError": ("Your key is too long", H.BAD_REQUEST),
+    "MalformedDate": ("Invalid date format header.", H.BAD_REQUEST),
+    "MalformedXML": ("The XML you provided was not well-formed or did not validate against our published schema.", H.BAD_REQUEST),
+    "MethodNotAllowed": ("The specified method is not allowed against this resource.", H.METHOD_NOT_ALLOWED),
+    "MissingContentLength": ("You must provide the Content-Length HTTP header.", H.LENGTH_REQUIRED),
+    "NoSuchBucket": ("The specified bucket does not exist", H.NOT_FOUND),
+    "NoSuchBucketPolicy": ("The bucket policy does not exist", H.NOT_FOUND),
+    "NoSuchKey": ("The specified key does not exist.", H.NOT_FOUND),
+    "NoSuchUpload": ("The specified multipart upload does not exist.", H.NOT_FOUND),
+    "NoSuchVersion": ("The specified version does not exist.", H.NOT_FOUND),
+    "NotImplemented": ("A header you provided implies functionality that is not implemented", H.NOT_IMPLEMENTED),
+    "PreconditionFailed": ("At least one of the pre-conditions you specified did not hold", H.PRECONDITION_FAILED),
+    "RequestNotReadyYet": ("Request is not valid yet", H.FORBIDDEN),
+    "RequestTimeTooSkewed": ("The difference between the request time and the server's time is too large.", H.FORBIDDEN),
+    "SignatureDoesNotMatch": ("The request signature we calculated does not match the signature you provided.", H.FORBIDDEN),
+    "SignatureVersionNotSupported": ("The authorization mechanism you have provided is not supported.", H.BAD_REQUEST),
+    "SlowDown": ("Resource requested is unreadable, please reduce your request rate", H.SERVICE_UNAVAILABLE),
+    "XAmzContentSHA256Mismatch": ("The provided 'x-amz-content-sha256' header does not match what was computed.", H.BAD_REQUEST),
+    "AuthorizationHeaderMalformed": ("The authorization header is malformed.", H.BAD_REQUEST),
+    "AuthorizationQueryParametersError": ("Query-string authentication parameters are malformed.", H.BAD_REQUEST),
+    "NotModified": ("Not Modified", H.NOT_MODIFIED),
+}
+
+
+def get(code: str, message: str = "") -> APIError:
+    msg, status = _E.get(code, _E["InternalError"])
+    return APIError(code, message or msg, int(status))
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str = ""):
+        self.err = get(code, message)
+        super().__init__(self.err.message)
+
+
+def from_exception(e: Exception) -> APIError:
+    """toAPIError: translate layer exceptions to S3 codes."""
+    if isinstance(e, S3Error):
+        return e.err
+    if isinstance(e, AuthError):
+        return get(e.code, str(e) if str(e) else "")
+    mapping = [
+        (olapi.BucketNotFound, "NoSuchBucket"),
+        (olapi.BucketExists, "BucketAlreadyOwnedByYou"),
+        (olapi.BucketNotEmpty, "BucketNotEmpty"),
+        (olapi.InvalidBucketName, "InvalidBucketName"),
+        (olapi.ObjectNotFound, "NoSuchKey"),
+        (olapi.VersionNotFound, "NoSuchVersion"),
+        (olapi.InvalidObjectName, "KeyTooLongError"),
+        (olapi.InvalidRange, "InvalidRange"),
+        (olapi.InvalidUploadID, "NoSuchUpload"),
+        (olapi.InvalidPartOrder, "InvalidPartOrder"),
+        (olapi.InvalidPart, "InvalidPart"),
+        (olapi.PreconditionFailed, "PreconditionFailed"),
+        (olapi.ReadQuorumError, "SlowDown"),
+        (olapi.WriteQuorumError, "SlowDown"),
+        (BadDigest, "BadDigest"),
+        (serrors.FileNotFound, "NoSuchKey"),
+        (serrors.VolumeNotFound, "NoSuchBucket"),
+    ]
+    for cls, code in mapping:
+        if isinstance(e, cls):
+            return get(code)
+    return get("InternalError", f"{type(e).__name__}: {e}")

@@ -193,6 +193,8 @@ class XLStorage(StorageAPI):
         tmp = os.path.join(
             self.root, TMP_DIR, f"wa-{uuid.uuid4().hex}"
         )
+        # the tmp area may have been pruned by delete_file parent cleanup
+        os.makedirs(os.path.dirname(tmp), exist_ok=True)
         with open(tmp, "wb") as f:
             f.write(data)
             f.flush()

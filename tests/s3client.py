@@ -1,0 +1,132 @@
+"""Minimal SigV4-signing S3 client for black-box server tests.
+
+The in-process stand-in for the SDK clients the reference's mint suite
+uses; no boto3 in this image, so requests are built and signed by hand
+(like cmd/test-utils_test.go signRequestV4).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import http.client
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+from minio_tpu.server import auth
+
+
+class S3Response:
+    def __init__(self, status: int, headers: dict, body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    @property
+    def xml(self) -> ET.Element:
+        return ET.fromstring(self.body)
+
+    def xml_text(self, tag: str) -> str:
+        """First matching tag text, namespace-insensitive."""
+        for el in self.xml.iter():
+            if el.tag.split("}")[-1] == tag:
+                return el.text or ""
+        return ""
+
+    def xml_all(self, tag: str) -> list[str]:
+        return [
+            el.text or ""
+            for el in self.xml.iter()
+            if el.tag.split("}")[-1] == tag
+        ]
+
+    @property
+    def error_code(self) -> str:
+        try:
+            return self.xml_text("Code")
+        except ET.ParseError:
+            return ""
+
+
+class S3Client:
+    def __init__(
+        self,
+        endpoint: str,
+        access_key: str = "minioadmin",
+        secret_key: str = "minioadmin",
+        region: str = "us-east-1",
+    ):
+        parsed = urllib.parse.urlsplit(endpoint)
+        self.host = parsed.hostname
+        self.port = parsed.port or 80
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        query: "dict[str, str] | None" = None,
+        body: bytes = b"",
+        headers: "dict[str, str] | None" = None,
+        sign: bool = True,
+    ) -> S3Response:
+        query = dict(query or {})
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        amz_date = datetime.datetime.now(
+            datetime.timezone.utc
+        ).strftime("%Y%m%dT%H%M%SZ")
+        phash = hashlib.sha256(body).hexdigest()
+        headers.setdefault("host", f"{self.host}:{self.port}")
+        if sign:
+            headers["x-amz-date"] = amz_date
+            headers["x-amz-content-sha256"] = phash
+            signed = sorted(headers)
+            qmap = {k: [v] for k, v in query.items()}
+            sig = auth.sign_v4(
+                method, path, qmap, headers, signed, phash,
+                self.access_key, self.secret_key, amz_date, self.region,
+            )
+            scope = f"{amz_date[:8]}/{self.region}/s3/aws4_request"
+            headers["authorization"] = (
+                f"{auth.SIGN_V4_ALGORITHM} "
+                f"Credential={self.access_key}/{scope}, "
+                f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+            )
+        qs = urllib.parse.urlencode(query)
+        url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        try:
+            conn.request(method, url, body=body or None, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return S3Response(
+                resp.status, {k.lower(): v for k, v in resp.getheaders()}, data
+            )
+        finally:
+            conn.close()
+
+    # -- conveniences -----------------------------------------------------
+
+    def make_bucket(self, bucket):
+        return self.request("PUT", f"/{bucket}")
+
+    def put_object(self, bucket, key, data: bytes, headers=None):
+        return self.request(
+            "PUT", f"/{bucket}/{key}", body=data, headers=headers
+        )
+
+    def get_object(self, bucket, key, headers=None, query=None):
+        return self.request(
+            "GET", f"/{bucket}/{key}", headers=headers, query=query
+        )
+
+    def head_object(self, bucket, key, headers=None):
+        return self.request("HEAD", f"/{bucket}/{key}", headers=headers)
+
+    def delete_object(self, bucket, key):
+        return self.request("DELETE", f"/{bucket}/{key}")
+
+    def list_objects(self, bucket, **query):
+        return self.request("GET", f"/{bucket}", query=query)

@@ -1,0 +1,114 @@
+"""Multipart upload tests (cmd/object-api-multipart_test.go intent)."""
+
+import hashlib
+import io
+
+import numpy as np
+import pytest
+
+from minio_tpu.objectlayer import api
+from minio_tpu.objectlayer.api import CompletePart
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.storage.xl import XLStorage
+
+BLOCK = 4096
+
+
+@pytest.fixture
+def ol(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=BLOCK)
+    layer.make_bucket("bucket")
+    return layer
+
+
+def _payload(size, seed):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8
+    ).tobytes()
+
+
+def test_multipart_roundtrip(ol):
+    uid = ol.new_multipart_upload(
+        "bucket", "big", {"content-type": "app/bin"}
+    )
+    parts_payload = [
+        _payload(2 * BLOCK + 11, 1),
+        _payload(BLOCK, 2),
+        _payload(333, 3),
+    ]
+    completes = []
+    for i, pp in enumerate(parts_payload, start=1):
+        pi = ol.put_object_part(
+            "bucket", "big", uid, i, io.BytesIO(pp), len(pp)
+        )
+        assert pi.size == len(pp)
+        assert pi.etag == hashlib.md5(pp).hexdigest()
+        completes.append(CompletePart(i, pi.etag))
+    listed = ol.list_object_parts("bucket", "big", uid)
+    assert [p.part_number for p in listed] == [1, 2, 3]
+    info = ol.complete_multipart_upload("bucket", "big", uid, completes)
+    want = b"".join(parts_payload)
+    assert info.size == len(want)
+    assert info.etag.endswith("-3")
+    buf = io.BytesIO()
+    ginfo = ol.get_object("bucket", "big", buf)
+    assert buf.getvalue() == want
+    assert ginfo.content_type == "app/bin"
+    # upload dir cleaned up
+    with pytest.raises(api.InvalidUploadID):
+        ol.list_object_parts("bucket", "big", uid)
+    # range read across part boundary
+    off = 2 * BLOCK + 5
+    buf = io.BytesIO()
+    ol.get_object("bucket", "big", buf, offset=off, length=BLOCK)
+    assert buf.getvalue() == want[off : off + BLOCK]
+
+
+def test_multipart_subset_and_order(ol):
+    uid = ol.new_multipart_upload("bucket", "obj", {})
+    p1 = _payload(BLOCK, 4)
+    p3 = _payload(500, 5)
+    e1 = ol.put_object_part("bucket", "obj", uid, 1, io.BytesIO(p1), len(p1)).etag
+    ol.put_object_part("bucket", "obj", uid, 2, io.BytesIO(b"skipme"), 6)
+    e3 = ol.put_object_part("bucket", "obj", uid, 3, io.BytesIO(p3), len(p3)).etag
+    # complete with parts 1 and 3 only -> renumbered 1,2
+    info = ol.complete_multipart_upload(
+        "bucket", "obj", uid, [CompletePart(1, e1), CompletePart(3, e3)]
+    )
+    buf = io.BytesIO()
+    ol.get_object("bucket", "obj", buf)
+    assert buf.getvalue() == p1 + p3
+    # out-of-order completion rejected
+    uid2 = ol.new_multipart_upload("bucket", "o2", {})
+    ol.put_object_part("bucket", "o2", uid2, 1, io.BytesIO(b"a"), 1)
+    ol.put_object_part("bucket", "o2", uid2, 2, io.BytesIO(b"b"), 1)
+    with pytest.raises(api.InvalidPartOrder):
+        ol.complete_multipart_upload(
+            "bucket", "o2", uid2,
+            [CompletePart(2, ""), CompletePart(1, "")],
+        )
+
+
+def test_abort_and_bad_upload_id(ol):
+    uid = ol.new_multipart_upload("bucket", "obj", {})
+    ol.put_object_part("bucket", "obj", uid, 1, io.BytesIO(b"xy"), 2)
+    uploads = ol.list_multipart_uploads("bucket")
+    assert [u.upload_id for u in uploads] == [uid]
+    ol.abort_multipart_upload("bucket", "obj", uid)
+    assert ol.list_multipart_uploads("bucket") == []
+    with pytest.raises(api.InvalidUploadID):
+        ol.put_object_part("bucket", "obj", uid, 2, io.BytesIO(b"z"), 1)
+    with pytest.raises(api.InvalidUploadID):
+        ol.complete_multipart_upload(
+            "bucket", "obj", "deadbeef", [CompletePart(1, "")]
+        )
+
+
+def test_part_etag_mismatch(ol):
+    uid = ol.new_multipart_upload("bucket", "obj", {})
+    ol.put_object_part("bucket", "obj", uid, 1, io.BytesIO(b"data"), 4)
+    with pytest.raises(api.InvalidPart):
+        ol.complete_multipart_upload(
+            "bucket", "obj", uid, [CompletePart(1, "0" * 32)]
+        )

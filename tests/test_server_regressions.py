@@ -1,0 +1,191 @@
+"""Regression tests for review findings on the server/multipart paths."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+BLOCK = 4096
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("disks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = S3Client(server.endpoint)
+    c.make_bucket("reg")
+    return c
+
+
+def _initiate(client, bucket, key):
+    r = client.request("POST", f"/{bucket}/{key}", query={"uploads": ""})
+    assert r.status == 200
+    return r.xml_text("UploadId")
+
+
+def test_multipart_initiate_after_part_upload(client):
+    """Finding 1: uploading a part used to prune .sys/tmp, breaking every
+    subsequent initiate with 503."""
+    uid_a = _initiate(client, "reg", "obj-a")
+    r = client.request(
+        "PUT", "/reg/obj-a",
+        query={"partNumber": "1", "uploadId": uid_a}, body=b"part-one",
+    )
+    assert r.status == 200
+    uid_b = _initiate(client, "reg", "obj-b")  # must not 503
+    assert uid_b
+    # plain PUT also exercises write_all staging
+    assert client.put_object("reg", "plain", b"x").status == 200
+
+
+def test_complete_validates_bucket_and_object(client):
+    """Finding 2: an upload id must only complete into the bucket/object
+    it was initiated for."""
+    uid = _initiate(client, "reg", "victim")
+    r = client.request(
+        "PUT", "/reg/victim",
+        query={"partNumber": "1", "uploadId": uid}, body=b"data",
+    )
+    etag = r.headers["etag"].strip('"')
+    body = (
+        f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+        f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>"
+    ).encode()
+    # wrong object
+    r = client.request(
+        "POST", "/reg/other-object", query={"uploadId": uid}, body=body
+    )
+    assert r.status == 404
+    assert r.error_code == "NoSuchUpload"
+    # wrong bucket (does not exist -> NoSuchBucket; exists -> NoSuchUpload)
+    r = client.request(
+        "POST", "/nosuchbkt/victim", query={"uploadId": uid}, body=body
+    )
+    assert r.status == 404
+    # right target still completes after the failed attempts
+    r = client.request(
+        "POST", "/reg/victim", query={"uploadId": uid}, body=body
+    )
+    assert r.status == 200
+
+
+def test_part_order_error_code(client):
+    """Finding 6: out-of-order part lists return InvalidPartOrder."""
+    uid = _initiate(client, "reg", "ooo")
+    etags = {}
+    for i in (1, 2):
+        r = client.request(
+            "PUT", "/reg/ooo",
+            query={"partNumber": str(i), "uploadId": uid},
+            body=f"part{i}".encode(),
+        )
+        etags[i] = r.headers["etag"].strip('"')
+    body = (
+        f"<CompleteMultipartUpload>"
+        f"<Part><PartNumber>2</PartNumber><ETag>{etags[2]}</ETag></Part>"
+        f"<Part><PartNumber>1</PartNumber><ETag>{etags[1]}</ETag></Part>"
+        f"</CompleteMultipartUpload>"
+    ).encode()
+    r = client.request(
+        "POST", "/reg/ooo", query={"uploadId": uid}, body=body
+    )
+    assert r.status == 400
+    assert r.error_code == "InvalidPartOrder"
+
+
+def test_malformed_list_params(client):
+    """Finding 4: malformed query params are 400, not 500."""
+    r = client.list_objects("reg", **{"max-keys": "abc"})
+    assert r.status == 400
+    assert r.error_code == "InvalidArgument"
+    r = client.list_objects(
+        "reg", **{"list-type": "2", "continuation-token": "!!!notb64!!!"}
+    )
+    assert r.status == 400
+    assert r.error_code == "InvalidArgument"
+
+
+def test_oversize_put_connection_close(server):
+    """Finding 3: rejecting an unread body must not desync keep-alive."""
+    import http.client
+
+    conn = http.client.HTTPConnection(
+        server.host, server.port, timeout=10
+    )
+    try:
+        conn.putrequest("PUT", "/reg/too-big")
+        conn.putheader("Content-Length", str(2 << 30))
+        conn.endheaders()
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 400
+        assert b"EntityTooLarge" in body
+        # server must close the connection rather than misparse the
+        # (never-sent) body as a next request
+        assert resp.getheader("Connection") == "close" or resp.isclosed()
+    finally:
+        conn.close()
+
+
+def test_streaming_get_large_object(client):
+    """Finding 7: GET streams; a multi-block object arrives intact with
+    correct Content-Length."""
+    payload = np.random.default_rng(9).integers(
+        0, 256, 20 * BLOCK + 123, dtype=np.uint8
+    ).tobytes()
+    client.put_object("reg", "large", payload)
+    r = client.get_object("reg", "large")
+    assert int(r.headers["content-length"]) == len(payload)
+    assert r.body == payload
+    assert hashlib.md5(r.body).hexdigest() == hashlib.md5(payload).hexdigest()
+
+
+def test_date_header_signing(server):
+    """Finding 8: signing with an RFC1123 Date header (no x-amz-date)."""
+    import datetime
+    import hashlib as hl
+    import http.client
+
+    from minio_tpu.server import auth as sauth
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    rfc_date = now.strftime("%a, %d %b %Y %H:%M:%S GMT")
+    iso_date = now.strftime("%Y%m%dT%H%M%SZ")
+    phash = hl.sha256(b"").hexdigest()
+    headers = {
+        "date": rfc_date,
+        "host": f"{server.host}:{server.port}",
+        "x-amz-content-sha256": phash,
+    }
+    signed = sorted(headers)
+    sig = sauth.sign_v4(
+        "GET", "/reg", {}, headers, signed, phash,
+        "minioadmin", "minioadmin", iso_date,
+    )
+    headers["Authorization"] = (
+        f"{sauth.SIGN_V4_ALGORITHM} Credential=minioadmin/"
+        f"{iso_date[:8]}/us-east-1/s3/aws4_request, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+    )
+    conn = http.client.HTTPConnection(server.host, server.port, timeout=10)
+    try:
+        conn.request("GET", "/reg", headers=headers)
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200
+    finally:
+        conn.close()
