@@ -1,0 +1,226 @@
+"""ErasureSets: hash-routed collection of erasure sets (cmd/erasure-sets.go).
+
+Data-parallel partitioning: S independent sets of N drives each; every
+object deterministically lands in set crc32(key) % S (crcHashMod,
+erasure-sets.go:560), so sets scale capacity and parallelism without
+cross-set coordination.  Bucket operations fan out to every set; listings
+merge lexically across sets (the lexicallySortedEntry merge,
+erasure-sets.go:842).
+"""
+
+from __future__ import annotations
+
+import binascii
+
+from . import api
+from .api import ListObjectsInfo, ObjectLayer
+from .erasure_object import ErasureObjects
+
+
+def crc_hash_mod(key: str, cardinality: int) -> int:
+    """Set index for an object key (crcHashMod, erasure-sets.go:576)."""
+    if cardinality <= 0:
+        return -1
+    return binascii.crc32(key.encode()) % cardinality
+
+
+class ErasureSets(ObjectLayer):
+    def __init__(
+        self,
+        disks: list,
+        set_count: int,
+        drives_per_set: int,
+        parity_blocks: "int | None" = None,
+        block_size: "int | None" = None,
+        nslock=None,
+    ):
+        if len(disks) != set_count * drives_per_set:
+            raise ValueError("disk count != sets * drives")
+        from ..codec.erasure import BLOCK_SIZE_V1
+        from ..dsync.namespace import NamespaceLock
+
+        self.set_count = set_count
+        self.drives_per_set = drives_per_set
+        nslock = nslock or NamespaceLock()
+        self.sets: list[ErasureObjects] = [
+            ErasureObjects(
+                disks[i * drives_per_set : (i + 1) * drives_per_set],
+                parity_blocks=parity_blocks,
+                block_size=block_size or BLOCK_SIZE_V1,
+                nslock=nslock,
+            )
+            for i in range(set_count)
+        ]
+
+    # -- routing ----------------------------------------------------------
+
+    def set_for(self, object_name: str) -> ErasureObjects:
+        return self.sets[crc_hash_mod(object_name, self.set_count)]
+
+    # -- buckets (fan out to all sets) ------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        made = []
+        try:
+            for s in self.sets:
+                s.make_bucket(bucket)
+                made.append(s)
+        except Exception:
+            for s in made:  # undo partial creation (like undoMakeBucket)
+                try:
+                    s.delete_bucket(bucket, force=True)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+
+    def get_bucket_info(self, bucket: str):
+        return self.sets[0].get_bucket_info(bucket)
+
+    def list_buckets(self):
+        return self.sets[0].list_buckets()
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        # validate emptiness across all sets first when not forcing
+        if not force:
+            for s in self.sets:
+                if s.list_objects(bucket, max_keys=1).objects:
+                    raise api.BucketNotEmpty(bucket)
+        for s in self.sets:
+            try:
+                s.delete_bucket(bucket, force=True)
+            except api.BucketNotFound:
+                pass
+
+    # -- objects (route by key) -------------------------------------------
+
+    def put_object(self, bucket, object_name, reader, size=-1, metadata=None):
+        return self.set_for(object_name).put_object(
+            bucket, object_name, reader, size, metadata
+        )
+
+    def get_object(self, bucket, object_name, writer, offset=0, length=-1,
+                   version_id=""):
+        return self.set_for(object_name).get_object(
+            bucket, object_name, writer, offset, length, version_id
+        )
+
+    def get_object_info(self, bucket, object_name, version_id=""):
+        return self.set_for(object_name).get_object_info(
+            bucket, object_name, version_id
+        )
+
+    def delete_object(self, bucket, object_name, version_id=""):
+        return self.set_for(object_name).delete_object(
+            bucket, object_name, version_id
+        )
+
+    def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
+                    metadata=None):
+        import io
+
+        src_set = self.set_for(src_object)
+        dst_set = self.set_for(dst_object)
+        if src_set is dst_set:
+            return src_set.copy_object(
+                src_bucket, src_object, dst_bucket, dst_object, metadata
+            )
+        info = src_set.get_object_info(src_bucket, src_object)
+        buf = io.BytesIO()
+        src_set.get_object(src_bucket, src_object, buf)
+        buf.seek(0)
+        meta = dict(info.user_defined)
+        if metadata:
+            meta.update(metadata)
+        meta.pop("etag", None)
+        return dst_set.put_object(
+            dst_bucket, dst_object, buf, info.size, meta
+        )
+
+    def heal_object(self, bucket, object_name, version_id="", dry_run=False):
+        return self.set_for(object_name).heal_object(
+            bucket, object_name, version_id, dry_run
+        )
+
+    # -- listing (merge across sets) --------------------------------------
+
+    def list_objects(self, bucket, prefix="", marker="", delimiter="",
+                     max_keys=1000) -> ListObjectsInfo:
+        results = [
+            s.list_objects(bucket, prefix, marker, delimiter, max_keys)
+            for s in self.sets
+        ]
+        return merge_list_results(results, max_keys)
+
+    # -- multipart (route by key) -----------------------------------------
+
+    def new_multipart_upload(self, bucket, object_name, metadata=None):
+        return self.set_for(object_name).new_multipart_upload(
+            bucket, object_name, metadata
+        )
+
+    def put_object_part(self, bucket, object_name, upload_id, part_number,
+                        reader, size=-1):
+        return self.set_for(object_name).put_object_part(
+            bucket, object_name, upload_id, part_number, reader, size
+        )
+
+    def list_object_parts(self, bucket, object_name, upload_id,
+                          part_marker=0, max_parts=1000):
+        return self.set_for(object_name).list_object_parts(
+            bucket, object_name, upload_id, part_marker, max_parts
+        )
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for s in self.sets:
+            out.extend(s.list_multipart_uploads(bucket, prefix))
+        out.sort(key=lambda u: (u.object, u.upload_id))
+        return out
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        return self.set_for(object_name).abort_multipart_upload(
+            bucket, object_name, upload_id
+        )
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id,
+                                  parts):
+        return self.set_for(object_name).complete_multipart_upload(
+            bucket, object_name, upload_id, parts
+        )
+
+    def storage_info(self) -> dict:
+        infos = [s.storage_info() for s in self.sets]
+        return {
+            "sets": infos,
+            "disks": sum(i["disks"] for i in infos),
+            "online": sum(i["online"] for i in infos),
+            "offline": sum(i["offline"] for i in infos),
+        }
+
+
+def merge_list_results(
+    results: list[ListObjectsInfo], max_keys: int
+) -> ListObjectsInfo:
+    """Lexical merge of per-set/per-zone listings, re-truncated to
+    max_keys (lexicallySortedEntry, erasure-sets.go:842)."""
+    objects = {o.name: o for r in results for o in r.objects}
+    prefixes = {p for r in results for p in r.prefixes}
+    entries = sorted(
+        [(name, "o") for name in objects] + [(p, "p") for p in prefixes]
+    )
+    out = ListObjectsInfo()
+    truncated_tail = any(r.is_truncated for r in results)
+    for i, (name, kind) in enumerate(entries):
+        if len(out.objects) + len(out.prefixes) >= max_keys:
+            out.is_truncated = True
+            out.next_marker = entries[i - 1][0] if i else ""
+            break
+        if kind == "o":
+            out.objects.append(objects[name])
+        else:
+            out.prefixes.append(name)
+    else:
+        out.is_truncated = truncated_tail
+        if truncated_tail and entries:
+            out.next_marker = entries[-1][0]
+    return out

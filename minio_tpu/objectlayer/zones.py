@@ -1,0 +1,219 @@
+"""ErasureZones: capacity-routed server pools (cmd/erasure-zones.go).
+
+The top-level ObjectLayer in server mode (newObjectLayer,
+server-main.go:559): writes go to the zone with the most free space
+(getAvailableZoneIdx, erasure-zones.go:113), reads/deletes query zones in
+order, listings merge across zones.  Each zone is an ErasureSets.
+"""
+
+from __future__ import annotations
+
+import random
+
+from . import api
+from .api import ListObjectsInfo, ObjectLayer
+from .sets import ErasureSets, merge_list_results
+
+
+class ErasureZones(ObjectLayer):
+    def __init__(self, zones: list[ErasureSets]):
+        if not zones:
+            raise ValueError("need at least one zone")
+        self.zones = zones
+
+    # -- placement --------------------------------------------------------
+
+    def _zone_free(self, zone: ErasureSets) -> int:
+        free = 0
+        for s in zone.sets:
+            for d in s._online_disks():
+                if d is None:
+                    continue
+                try:
+                    free += d.disk_info().free
+                except Exception:  # noqa: BLE001
+                    pass
+        return free
+
+    def _put_zone_index(self, bucket: str, object_name: str) -> int:
+        """Zone for a new write: existing object stays in its zone
+        (erasure-zones.go getZoneIdx), else weighted by free space."""
+        for i, z in enumerate(self.zones):
+            try:
+                z.get_object_info(bucket, object_name)
+                return i
+            except Exception:  # noqa: BLE001
+                continue
+        if len(self.zones) == 1:
+            return 0
+        frees = [self._zone_free(z) for z in self.zones]
+        total = sum(frees)
+        if total <= 0:
+            return 0
+        # deterministic-enough weighted choice (reference uses free
+        # threshold ratios, erasure-zones.go:113-184)
+        r = random.random() * total
+        acc = 0
+        for i, f in enumerate(frees):
+            acc += f
+            if r <= acc:
+                return i
+        return len(self.zones) - 1
+
+    def _find_zone(self, bucket: str, object_name: str, version_id=""):
+        last_err: Exception = api.ObjectNotFound(
+            f"{bucket}/{object_name}"
+        )
+        for z in self.zones:
+            try:
+                z.get_object_info(bucket, object_name, version_id)
+                return z
+            except (api.ObjectNotFound, api.VersionNotFound) as e:
+                last_err = e
+        raise last_err
+
+    # -- buckets ----------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        made = []
+        try:
+            for z in self.zones:
+                z.make_bucket(bucket)
+                made.append(z)
+        except Exception:
+            for z in made:
+                try:
+                    z.delete_bucket(bucket, force=True)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+
+    def get_bucket_info(self, bucket: str):
+        return self.zones[0].get_bucket_info(bucket)
+
+    def list_buckets(self):
+        return self.zones[0].list_buckets()
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        if not force:
+            for z in self.zones:
+                if z.list_objects(bucket, max_keys=1).objects:
+                    raise api.BucketNotEmpty(bucket)
+        for z in self.zones:
+            try:
+                z.delete_bucket(bucket, force=True)
+            except api.BucketNotFound:
+                pass
+
+    # -- objects ----------------------------------------------------------
+
+    def put_object(self, bucket, object_name, reader, size=-1, metadata=None):
+        self.zones[0].get_bucket_info(bucket)  # bucket must exist
+        zi = self._put_zone_index(bucket, object_name)
+        return self.zones[zi].put_object(
+            bucket, object_name, reader, size, metadata
+        )
+
+    def get_object(self, bucket, object_name, writer, offset=0, length=-1,
+                   version_id=""):
+        self.zones[0].get_bucket_info(bucket)
+        z = self._find_zone(bucket, object_name, version_id)
+        return z.get_object(
+            bucket, object_name, writer, offset, length, version_id
+        )
+
+    def get_object_info(self, bucket, object_name, version_id=""):
+        self.zones[0].get_bucket_info(bucket)
+        z = self._find_zone(bucket, object_name, version_id)
+        return z.get_object_info(bucket, object_name, version_id)
+
+    def delete_object(self, bucket, object_name, version_id=""):
+        self.zones[0].get_bucket_info(bucket)
+        z = self._find_zone(bucket, object_name, version_id)
+        return z.delete_object(bucket, object_name, version_id)
+
+    def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
+                    metadata=None):
+        import io
+
+        src_zone = self._find_zone(src_bucket, src_object)
+        info = src_zone.get_object_info(src_bucket, src_object)
+        buf = io.BytesIO()
+        src_zone.get_object(src_bucket, src_object, buf)
+        buf.seek(0)
+        meta = dict(info.user_defined)
+        if metadata:
+            meta.update(metadata)
+        meta.pop("etag", None)
+        return self.put_object(
+            dst_bucket, dst_object, buf, info.size, meta
+        )
+
+    def heal_object(self, bucket, object_name, version_id="", dry_run=False):
+        z = self._find_zone(bucket, object_name, version_id)
+        return z.heal_object(bucket, object_name, version_id, dry_run)
+
+    # -- listing ----------------------------------------------------------
+
+    def list_objects(self, bucket, prefix="", marker="", delimiter="",
+                     max_keys=1000) -> ListObjectsInfo:
+        self.zones[0].get_bucket_info(bucket)
+        results = [
+            z.list_objects(bucket, prefix, marker, delimiter, max_keys)
+            for z in self.zones
+        ]
+        return merge_list_results(results, max_keys)
+
+    # -- multipart (pin the upload's zone at initiate time) ---------------
+
+    def new_multipart_upload(self, bucket, object_name, metadata=None):
+        self.zones[0].get_bucket_info(bucket)
+        zi = self._put_zone_index(bucket, object_name)
+        uid = self.zones[zi].new_multipart_upload(
+            bucket, object_name, metadata
+        )
+        return f"{zi}.{uid}"
+
+    def _upload_zone(self, upload_id: str):
+        try:
+            zi, uid = upload_id.split(".", 1)
+            return self.zones[int(zi)], uid
+        except (ValueError, IndexError):
+            raise api.InvalidUploadID(upload_id) from None
+
+    def put_object_part(self, bucket, object_name, upload_id, part_number,
+                        reader, size=-1):
+        z, uid = self._upload_zone(upload_id)
+        return z.put_object_part(
+            bucket, object_name, uid, part_number, reader, size
+        )
+
+    def list_object_parts(self, bucket, object_name, upload_id,
+                          part_marker=0, max_parts=1000):
+        z, uid = self._upload_zone(upload_id)
+        return z.list_object_parts(
+            bucket, object_name, uid, part_marker, max_parts
+        )
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for zi, z in enumerate(self.zones):
+            for u in z.list_multipart_uploads(bucket, prefix):
+                u.upload_id = f"{zi}.{u.upload_id}"
+                out.append(u)
+        out.sort(key=lambda u: (u.object, u.upload_id))
+        return out
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        z, uid = self._upload_zone(upload_id)
+        return z.abort_multipart_upload(bucket, object_name, uid)
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id,
+                                  parts):
+        z, uid = self._upload_zone(upload_id)
+        return z.complete_multipart_upload(
+            bucket, object_name, uid, parts
+        )
+
+    def storage_info(self) -> dict:
+        return {"zones": [z.storage_info() for z in self.zones]}

@@ -1,10 +1,11 @@
-"""CLI entry: ``python -m minio_tpu.server [--address host:port] disk...``
+"""CLI entry: ``python -m minio_tpu.server [--address host:port] args...``
 
-The `minio server` analogue (cmd/server-main.go): builds the object layer
-from disk paths (single path -> still erasure with minimum disks is not
-possible, so 1 path runs a 1-disk FS-style layout only when provided 1
-path; >=4 paths build one erasure set; sets/zones routing arrives with
-the distributed plane).
+The `minio server` analogue (cmd/server-main.go): each positional arg is
+one zone; ellipses patterns expand to that zone's drives
+(``/data/disk{1...8}``), drives are partitioned into erasure sets
+(endpoint-ellipses.go GCD math), format.json is created/quorum-loaded per
+zone, and the object layer is Zones(Sets(Objects)) exactly like
+newObjectLayer (server-main.go:559-567).
 """
 
 from __future__ import annotations
@@ -15,9 +16,41 @@ import signal
 import sys
 
 
+def build_object_layer(zone_args: list[str], parity: "int | None" = None):
+    """Expand args -> formatted, ordered disks -> zones object layer."""
+    from ..objectlayer.format import load_or_init_format
+    from ..objectlayer.sets import ErasureSets
+    from ..objectlayer.zones import ErasureZones
+    from ..storage.xl import XLStorage
+    from ..utils import ellipses
+
+    zones = []
+    for zarg in zone_args:
+        paths = ellipses.expand(zarg)
+        if len(paths) < 2:
+            raise SystemExit(
+                f"zone {zarg!r} expands to {len(paths)} drives; need >= 2"
+            )
+        set_count, drives_per_set = ellipses.layout(len(paths))
+        disks = [XLStorage(p) for p in paths]
+        _, ordered = load_or_init_format(
+            disks, set_count, drives_per_set
+        )
+        zones.append(
+            ErasureSets(
+                ordered, set_count, drives_per_set, parity_blocks=parity
+            )
+        )
+    return ErasureZones(zones)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="minio-tpu server")
-    p.add_argument("disks", nargs="+", help="disk paths (>= 2)")
+    p.add_argument(
+        "zones",
+        nargs="+",
+        help="one arg per zone; ellipses expand: /data/disk{1...8}",
+    )
     p.add_argument("--address", default="0.0.0.0:9000")
     p.add_argument(
         "--access-key",
@@ -28,17 +61,15 @@ def main(argv=None) -> int:
         default=os.environ.get("MINIO_SECRET_KEY", "minioadmin"),
     )
     p.add_argument("--region", default="us-east-1")
+    p.add_argument(
+        "--parity", type=int, default=None,
+        help="parity drives per set (default: half)",
+    )
     args = p.parse_args(argv)
 
-    from ..objectlayer.erasure_object import ErasureObjects
-    from ..storage.xl import XLStorage
     from .http import S3Server
 
-    if len(args.disks) < 2:
-        print("need at least 2 disk paths", file=sys.stderr)
-        return 2
-    disks = [XLStorage(d) for d in args.disks]
-    ol = ErasureObjects(disks)
+    ol = build_object_layer(args.zones, args.parity)
     srv = S3Server(
         ol,
         address=args.address,
@@ -46,9 +77,10 @@ def main(argv=None) -> int:
         secret_key=args.secret_key,
         region=args.region,
     ).start()
+    si = ol.storage_info()
     print(
-        f"minio-tpu serving {len(disks)} disks "
-        f"(EC {ol.data_blocks}+{ol.parity_blocks}) at {srv.endpoint}"
+        f"minio-tpu serving {len(ol.zones)} zone(s) "
+        f"{[z['disks'] for z in si['zones']]} drives at {srv.endpoint}"
     )
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}, shutting down")

@@ -1,0 +1,239 @@
+"""ErasureSets/Zones routing, format.json bootstrap, ellipses expansion.
+
+Mirrors prepareErasureSets32-style layouts (test-utils_test.go:185-202)
+scaled down to temp dirs.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from minio_tpu.objectlayer import api, format as fmt
+from minio_tpu.objectlayer.sets import ErasureSets, crc_hash_mod
+from minio_tpu.objectlayer.zones import ErasureZones
+from minio_tpu.storage import errors as serrors
+from minio_tpu.storage.xl import XLStorage
+from minio_tpu.utils import ellipses
+
+BLOCK = 2048
+
+
+def _disks(tmp_path, n, prefix="d"):
+    return [XLStorage(str(tmp_path / f"{prefix}{i}")) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# ellipses
+# ---------------------------------------------------------------------------
+
+
+def test_ellipses_expand():
+    assert ellipses.expand("/tmp/disk{1...4}") == [
+        "/tmp/disk1", "/tmp/disk2", "/tmp/disk3", "/tmp/disk4",
+    ]
+    got = ellipses.expand("http://h{1...2}/d{1...2}")
+    assert got == [
+        "http://h1/d1", "http://h1/d2", "http://h2/d1", "http://h2/d2",
+    ]
+    assert ellipses.expand("/plain") == ["/plain"]
+    # zero-padded
+    assert ellipses.expand("d{01...03}") == ["d01", "d02", "d03"]
+    with pytest.raises(ValueError):
+        ellipses.expand("d{5...2}")
+
+
+def test_set_layout_math():
+    assert ellipses.layout(4) == (1, 4)
+    assert ellipses.layout(16) == (1, 16)
+    assert ellipses.layout(32) == (2, 16)
+    assert ellipses.layout(20) == (2, 10)
+    assert ellipses.layout(18) == (2, 9)
+    with pytest.raises(ValueError):
+        ellipses.layout(17)
+
+
+# ---------------------------------------------------------------------------
+# format.json
+# ---------------------------------------------------------------------------
+
+
+def test_format_fresh_and_reload(tmp_path):
+    disks = _disks(tmp_path, 8)
+    ref, ordered = fmt.load_or_init_format(disks, 2, 4)
+    assert len(ref.sets) == 2 and len(ref.sets[0]) == 4
+    assert all(d is not None for d in ordered)
+    # reload keeps identity and ordering even when args are shuffled
+    shuffled = list(reversed(disks))
+    ref2, ordered2 = fmt.load_or_init_format(shuffled, 2, 4)
+    assert ref2.id == ref.id
+    assert [d.root for d in ordered2] == [d.root for d in ordered]
+
+
+def test_format_detects_foreign_disk(tmp_path):
+    disks = _disks(tmp_path, 4)
+    fmt.load_or_init_format(disks, 1, 4)
+    other = _disks(tmp_path, 4, prefix="x")
+    fmt.load_or_init_format(other, 1, 4)
+    mixed = disks[:3] + [other[0]]
+    with pytest.raises(serrors.InconsistentDisk):
+        fmt.load_or_init_format(mixed, 1, 4)
+
+
+def test_format_heals_fresh_disk_into_hole(tmp_path):
+    disks = _disks(tmp_path, 4)
+    ref, ordered = fmt.load_or_init_format(disks, 1, 4)
+    # wipe disk 2's format (fresh replacement drive)
+    import os, shutil
+
+    shutil.rmtree(disks[2].root)
+    os.makedirs(os.path.join(disks[2].root, ".sys", "tmp"))
+    ref2, ordered2 = fmt.load_or_init_format(disks, 1, 4)
+    assert ref2.id == ref.id
+    assert all(d is not None for d in ordered2)
+    # replacement got the hole's uuid
+    assert fmt.read_format(disks[2]).this in ref.sets[0]
+
+
+def test_format_layout_mismatch(tmp_path):
+    disks = _disks(tmp_path, 4)
+    fmt.load_or_init_format(disks, 1, 4)
+    with pytest.raises(serrors.CorruptedFormat):
+        fmt.load_or_init_format(disks, 2, 2)
+
+
+# ---------------------------------------------------------------------------
+# sets
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sets(tmp_path):
+    disks = _disks(tmp_path, 8)
+    s = ErasureSets(disks, 2, 4, block_size=BLOCK)
+    s.make_bucket("bucket")
+    return s
+
+
+def test_sets_routing_spreads(sets):
+    keys = [f"obj-{i}" for i in range(40)]
+    assert {crc_hash_mod(k, 2) for k in keys} == {0, 1}
+    for k in keys:
+        sets.put_object("bucket", k, io.BytesIO(b"v" + k.encode()), -1)
+    # each object lives only in its routed set
+    for k in keys:
+        routed = sets.set_for(k)
+        other = sets.sets[1 - sets.sets.index(routed)]
+        assert routed.get_object_info("bucket", k).name == k
+        with pytest.raises(api.ObjectNotFound):
+            other.get_object_info("bucket", k)
+    # full listing merges both sets in order
+    res = sets.list_objects("bucket", max_keys=1000)
+    assert [o.name for o in res.objects] == sorted(keys)
+
+
+def test_sets_roundtrip_and_delete(sets):
+    payload = np.random.default_rng(1).integers(
+        0, 256, 3 * BLOCK, dtype=np.uint8
+    ).tobytes()
+    sets.put_object("bucket", "obj", io.BytesIO(payload), len(payload))
+    buf = io.BytesIO()
+    sets.get_object("bucket", "obj", buf)
+    assert buf.getvalue() == payload
+    sets.delete_object("bucket", "obj")
+    with pytest.raises(api.ObjectNotFound):
+        sets.get_object_info("bucket", "obj")
+
+
+def test_sets_cross_set_copy(sets):
+    # find two keys landing in different sets
+    k1 = "obj-a"
+    k2 = next(
+        f"x{i}"
+        for i in range(100)
+        if crc_hash_mod(f"x{i}", 2) != crc_hash_mod(k1, 2)
+    )
+    sets.put_object("bucket", k1, io.BytesIO(b"payload"), 7)
+    sets.copy_object("bucket", k1, "bucket", k2)
+    buf = io.BytesIO()
+    sets.get_object("bucket", k2, buf)
+    assert buf.getvalue() == b"payload"
+
+
+def test_sets_multipart_routes(sets):
+    uid = sets.new_multipart_upload("bucket", "mp-obj", {})
+    from minio_tpu.objectlayer.api import CompletePart
+
+    pi = sets.put_object_part(
+        "bucket", "mp-obj", uid, 1, io.BytesIO(b"part"), 4
+    )
+    sets.complete_multipart_upload(
+        "bucket", "mp-obj", uid, [CompletePart(1, pi.etag)]
+    )
+    buf = io.BytesIO()
+    sets.get_object("bucket", "mp-obj", buf)
+    assert buf.getvalue() == b"part"
+
+
+# ---------------------------------------------------------------------------
+# zones
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def zones(tmp_path):
+    z1 = ErasureSets(_disks(tmp_path, 4, "z1d"), 1, 4, block_size=BLOCK)
+    z2 = ErasureSets(_disks(tmp_path, 4, "z2d"), 1, 4, block_size=BLOCK)
+    z = ErasureZones([z1, z2])
+    z.make_bucket("bucket")
+    return z
+
+
+def test_zones_put_get_overwrite_stays(zones):
+    zones.put_object("bucket", "obj", io.BytesIO(b"v1"), 2)
+    home = next(
+        i
+        for i, zz in enumerate(zones.zones)
+        if _has(zz, "bucket", "obj")
+    )
+    # overwrite must stay in the same zone
+    zones.put_object("bucket", "obj", io.BytesIO(b"v2-longer"), 9)
+    assert _has(zones.zones[home], "bucket", "obj")
+    assert not _has(zones.zones[1 - home], "bucket", "obj")
+    buf = io.BytesIO()
+    zones.get_object("bucket", "obj", buf)
+    assert buf.getvalue() == b"v2-longer"
+    zones.delete_object("bucket", "obj")
+    with pytest.raises(api.ObjectNotFound):
+        zones.get_object_info("bucket", "obj")
+
+
+def _has(zone, bucket, obj) -> bool:
+    try:
+        zone.get_object_info(bucket, obj)
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def test_zones_listing_merges(zones):
+    for i in range(10):
+        zones.put_object("bucket", f"k{i}", io.BytesIO(b"x"), 1)
+    res = zones.list_objects("bucket")
+    assert [o.name for o in res.objects] == sorted(f"k{i}" for i in range(10))
+
+
+def test_zones_multipart_pinning(zones):
+    from minio_tpu.objectlayer.api import CompletePart
+
+    uid = zones.new_multipart_upload("bucket", "mp", {})
+    assert "." in uid
+    pi = zones.put_object_part("bucket", "mp", uid, 1, io.BytesIO(b"dd"), 2)
+    zones.complete_multipart_upload(
+        "bucket", "mp", uid, [CompletePart(1, pi.etag)]
+    )
+    buf = io.BytesIO()
+    zones.get_object("bucket", "mp", buf)
+    assert buf.getvalue() == b"dd"
+    with pytest.raises(api.InvalidUploadID):
+        zones.put_object_part("bucket", "mp", "9.bogus", 1, io.BytesIO(b""), 0)
