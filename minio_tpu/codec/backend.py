@@ -60,7 +60,37 @@ class CodecBackend:
 
 
 class TpuBackend(CodecBackend):
+    """Device backend: single-chip fused passes, mesh-parallel when the
+    process sees >1 device (the driver's virtual CPU mesh or a real pod
+    slice).  The mesh path shards stripes over "stripe" and the k data
+    shards over "shard" with an XOR all-reduce (parallel.mesh), mirroring
+    the reference's set- and disk-level fan-out (SURVEY.md section 2.4).
+    Set MINIO_MESH=0 to force the single-device path.
+    """
+
     name = "tpu"
+
+    def __init__(self):
+        self._meshes: dict[tuple[int, int], object] = {}
+
+    def _mesh_for(self, batch: int, k: int):
+        """Pick a mesh for this call's geometry, or None for single-device."""
+        import jax
+
+        if os.environ.get("MINIO_MESH", "1") == "0":
+            return None
+        devices = jax.devices()
+        if len(devices) <= 1:
+            return None
+        from ..parallel import mesh as pm
+
+        stripe, shard = pm.pick_axes(len(devices), batch, k)
+        key = (stripe, shard)
+        m = self._meshes.get(key)
+        if m is None:
+            m = pm.make_mesh(devices, stripe=stripe, shard=shard)
+            self._meshes[key] = m
+        return m
 
     def encode(self, data, parity_shards):
         import jax.numpy as jnp
@@ -69,6 +99,14 @@ class TpuBackend(CodecBackend):
 
         data = np.ascontiguousarray(data, dtype=np.uint8)
         B, k, L = data.shape
+        mesh = self._mesh_for(B, k)
+        if mesh is not None:
+            from ..parallel import mesh as pm
+
+            parity_w, digests = pm.mesh_encode_hash(
+                mesh, codec_step.host_bytes_to_words(data), parity_shards, L
+            )
+            return codec_step.host_words_to_bytes(parity_w), digests
         words = jnp.asarray(codec_step.host_bytes_to_words(data))
         parity_w, digests = codec_step.encode_and_hash_words(
             words, parity_shards, L
@@ -82,6 +120,19 @@ class TpuBackend(CodecBackend):
         from ..ops import codec_step
 
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        B = shards.shape[0]
+        mesh = self._mesh_for(B, data_shards)
+        if mesh is not None:
+            from ..parallel import mesh as pm
+
+            dw = pm.mesh_reconstruct(
+                mesh,
+                codec_step.host_bytes_to_words(shards),
+                tuple(bool(b) for b in present),
+                data_shards,
+                parity_shards,
+            )
+            return codec_step.host_words_to_bytes(dw)
         words = jnp.asarray(codec_step.host_bytes_to_words(shards))
         dw = codec_step.reconstruct_words_batch(
             words, tuple(bool(b) for b in present), data_shards, parity_shards
@@ -95,6 +146,13 @@ class TpuBackend(CodecBackend):
 
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
         B, n, L = shards.shape
+        mesh = self._mesh_for(B * n, 1)
+        if mesh is not None:
+            from ..parallel import mesh as pm
+
+            words = codec_step.host_bytes_to_words(shards)
+            flat = words.reshape(B * n, -1)
+            return pm.mesh_digest(mesh, flat, L).reshape(B, n, 8)
         words = jnp.asarray(codec_step.host_bytes_to_words(shards))
         got = phash.phash256_words_batched(words, L)
         return np.asarray(got)
