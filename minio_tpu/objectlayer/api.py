@@ -69,6 +69,10 @@ class InvalidPartOrder(ObjectLayerError):
     pass
 
 
+class EntityTooSmall(ObjectLayerError):
+    """Non-final multipart part below the S3 5 MiB minimum."""
+
+
 class PreconditionFailed(ObjectLayerError):
     pass
 

@@ -48,6 +48,8 @@ from .metadata import (
 
 SYS_VOL = ".sys"
 MP_DIR = "multipart"
+# S3 minimum size for any part other than the last (globalMinPartSize)
+MIN_PART_SIZE = 5 << 20
 
 
 class MultipartMixin:
@@ -295,7 +297,8 @@ class MultipartMixin:
         md5s = hashlib.md5()
         total = 0
         last = 0
-        for cp in parts:
+        min_part = getattr(self, "min_part_size", MIN_PART_SIZE)
+        for i, cp in enumerate(parts):
             if cp.part_number <= last:
                 raise api.InvalidPartOrder("parts out of order")
             last = cp.part_number
@@ -305,6 +308,12 @@ class MultipartMixin:
             size, etag, _ = pm
             if cp.etag and cp.etag.strip('"') != etag:
                 raise InvalidPart(f"part {cp.part_number} etag mismatch")
+            # S3 minimum part size applies to all but the last part
+            # (cmd/erasure-multipart.go CompleteMultipartUpload)
+            if i != len(parts) - 1 and size < min_part:
+                raise api.EntityTooSmall(
+                    f"part {cp.part_number} is {size} bytes"
+                )
             infos.append((cp, size))
             md5s.update(bytes.fromhex(etag))
             total += size

@@ -62,6 +62,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         parity_blocks: "int | None" = None,
         block_size: int = ecodec.BLOCK_SIZE_V1,
         nslock=None,
+        min_part_size: "int | None" = None,
     ):
         if len(disks) < 2:
             raise ValueError("erasure set needs >= 2 disks")
@@ -74,6 +75,11 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         if self.parity_blocks > n // 2:
             raise ValueError("parity cannot exceed half the disks")
         self.block_size = block_size
+        if min_part_size is None:
+            from .erasure_multipart import MIN_PART_SIZE
+
+            min_part_size = MIN_PART_SIZE
+        self.min_part_size = min_part_size
         from ..dsync.namespace import NamespaceLock
 
         self.nslock = nslock or NamespaceLock()
@@ -454,9 +460,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     errs.append(serrors.DiskNotFound("offline"))
                     continue
                 try:
-                    d.delete_file(bucket, object_name, recursive=True)
+                    if version_id:
+                        # delete only the requested version; the whole
+                        # directory must survive (advisor finding r1)
+                        d.delete_version(bucket, object_name, fi)
+                    else:
+                        d.delete_file(bucket, object_name, recursive=True)
                     errs.append(None)
-                except serrors.FileNotFound:
+                except (serrors.FileNotFound, serrors.VersionNotFound):
                     errs.append(None)
                 except Exception as e:  # noqa: BLE001
                     errs.append(e)

@@ -29,11 +29,34 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..objectlayer.api import CompletePart, ObjectInfo
 from ..utils.hashreader import HashReader
-from . import response as xmlr, s3errors
-from .auth import AuthError, Credentials, SigV4Verifier
+from . import auth as authmod, response as xmlr, s3errors
+from .auth import (
+    AuthError,
+    Credentials,
+    SigV4ChunkedReader,
+    SigV4Verifier,
+)
 from .s3errors import S3Error
 
-MAX_IN_MEMORY_BODY = 1 << 30  # single-PUT cap; larger objects use multipart
+MAX_IN_MEMORY_BODY = 1 << 30  # buffered-body cap (XML configs, POST forms)
+MAX_OBJECT_SIZE = 5 << 40  # globalMaxObjectSize (cmd/globals.go)
+
+
+class _LimitedReader:
+    """Reads at most ``limit`` bytes from the underlying socket file."""
+
+    def __init__(self, raw, limit: int):
+        self._raw = raw
+        self.remaining = limit
+
+    def read(self, n: int = -1) -> bytes:
+        if self.remaining <= 0:
+            return b""
+        if n < 0 or n > self.remaining:
+            n = self.remaining
+        chunk = self._raw.read(n)
+        self.remaining -= len(chunk)
+        return chunk
 
 
 class S3Server:
@@ -115,18 +138,85 @@ class _Handler(BaseHTTPRequestHandler):
         )
         return path, query
 
+    def _body_size(self) -> int:
+        """Declared body size; rejects framing we cannot stream safely."""
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        if te and te != "identity":
+            # stdlib does not decode chunked TE; reading it as raw bytes
+            # would desync the connection (advisor finding r1)
+            self.close_connection = True
+            raise S3Error("MissingContentLength")
+        cl = self.headers.get("Content-Length")
+        if cl is None:
+            if self.command in ("PUT", "POST"):
+                self.close_connection = True
+                raise S3Error("MissingContentLength")
+            return 0
+        try:
+            length = int(cl)
+        except ValueError:
+            self.close_connection = True
+            raise S3Error("InvalidArgument", "Content-Length") from None
+        if length < 0:
+            self.close_connection = True
+            raise S3Error("InvalidArgument", "Content-Length")
+        return length
+
+    def _open_body(self):
+        """(reader, decoded_size): the auth-appropriate body stream.
+
+        For aws-chunked requests the wire bytes are Content-Length long
+        but the object data is x-amz-decoded-content-length long, framed
+        and signature-verified by SigV4ChunkedReader.
+        """
+        length = self._body_size()
+        raw = _LimitedReader(self.rfile, length)
+        self._raw_body = raw
+        ctx = self._auth
+        if ctx is not None and ctx.streaming:
+            decoded = self.headers.get("x-amz-decoded-content-length")
+            if decoded is None:
+                raise S3Error("MissingContentLength")
+            return (
+                SigV4ChunkedReader(raw, ctx, int(decoded)),
+                int(decoded),
+            )
+        return raw, length
+
+    def _hash_reader(self, reader, size: int) -> HashReader:
+        """Wrap the body in the MD5/SHA256-verifying reader
+        (pkg/hash PutObjReader): Content-MD5 and the signed
+        x-amz-content-sha256 are checked as bytes stream through."""
+        md5_hdr = self.headers.get("Content-MD5", "")
+        md5_hex = ""
+        if md5_hdr:
+            try:
+                md5_hex = base64.b64decode(md5_hdr).hex()
+            except Exception:  # noqa: BLE001
+                raise S3Error("InvalidDigest") from None
+        sha_hex = ""
+        ctx = self._auth
+        if ctx is not None and ctx.content_sha256:
+            sha_hex = ctx.content_sha256
+        return HashReader(reader, size, md5_hex=md5_hex, sha256_hex=sha_hex)
+
     def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length") or 0)
-        if length > MAX_IN_MEMORY_BODY:
-            # reject without reading: the unread bytes would desync this
-            # keep-alive connection, so force it closed
+        """Fully buffer a (bounded) body - XML/config payloads."""
+        reader, size = self._open_body()
+        if size > MAX_IN_MEMORY_BODY:
             self.close_connection = True
             raise S3Error("EntityTooLarge")
-        if length:
-            body = self.rfile.read(length)
-        else:
-            body = b""
-        self._body_consumed = True
+        hr = self._hash_reader(reader, size)
+        chunks = []
+        while True:
+            c = hr.read(1 << 20)
+            if not c:
+                break
+            chunks.append(c)
+        body = b"".join(chunks)
+        if len(body) != size:
+            self.close_connection = True
+            raise S3Error("IncompleteBody")
         return body
 
     def _respond(
@@ -163,30 +253,68 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- entry ------------------------------------------------------------
 
+    def end_headers(self):
+        self._headers_sent = True
+        super().end_headers()
+
+    def _finish_body(self) -> None:
+        """Keep-alive hygiene: drain small unread remainders, otherwise
+        mark the connection dirty so it is closed rather than desynced."""
+        raw = getattr(self, "_raw_body", None)
+        if raw is not None:
+            if raw.remaining > (1 << 20):
+                self.close_connection = True
+            elif raw.remaining:
+                raw.read(raw.remaining)
+            return
+        cl = self.headers.get("Content-Length")
+        if cl and cl not in ("0", ""):
+            self.close_connection = True
+
+    def _is_post_policy(self, path: str, query) -> bool:
+        return (
+            self.command == "POST"
+            and "/" not in path.lstrip("/").rstrip("/")
+            and "delete" not in query
+            and (self.headers.get("Content-Type") or "").startswith(
+                "multipart/form-data"
+            )
+        )
+
     def route(self):
         path, query = self._parse()
-        self._body_consumed = False
+        self._headers_sent = False
+        self._raw_body = None
+        self._auth = None
         try:
-            body = self._read_body()
-            # authenticate (setAuthHandler / checkRequestAuthType)
-            self.s3.verifier.verify(
-                self.command,
-                path,
-                query,
-                dict(self.headers.items()),
-                body,
+            # body-framing validity precedes auth, matching the generic
+            # middleware order (requestValidityHandler, routers.go:41-79)
+            self._body_size()
+            # authenticate on headers only (setAuthHandler analogue);
+            # payload hashes are verified as the body streams through
+            ctx = self.s3.verifier.verify_stream(
+                self.command, path, query, dict(self.headers.items())
             )
-            self._dispatch(path, query, body)
+            self._auth = ctx
+            if ctx.anonymous and not self._is_post_policy(path, query):
+                raise S3Error("AccessDenied")
+            self._dispatch(path, query)
         except Exception as e:  # noqa: BLE001
-            if not self._body_consumed:
+            if self._headers_sent:
+                # mid-stream failure: a second response would be read as
+                # body bytes (advisor finding r1) - just cut the stream
                 self.close_connection = True
+                return
+            self._finish_body()
             self._error(s3errors.from_exception(e), path)
+        else:
+            self._finish_body()
 
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = route
 
     # -- dispatch (api-router.go route table) -----------------------------
 
-    def _dispatch(self, path: str, query, body: bytes):
+    def _dispatch(self, path: str, query):
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
@@ -207,16 +335,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._head_object(bucket, key, query)
             if m == "PUT":
                 if "partNumber" in query and "uploadId" in query:
-                    return self._put_part(bucket, key, query, body)
+                    return self._put_part(bucket, key, query)
                 if "x-amz-copy-source" in self.headers:
                     return self._copy_object(bucket, key)
-                return self._put_object(bucket, key, body)
+                return self._put_object(bucket, key)
             if m == "POST":
                 if "uploads" in query:
                     return self._initiate_multipart(bucket, key)
                 if "uploadId" in query:
                     return self._complete_multipart(
-                        bucket, key, query, body
+                        bucket, key, query, self._read_body()
                     )
             if m == "DELETE":
                 if "uploadId" in query:
@@ -250,7 +378,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._respond(204)
         if m == "POST":
             if "delete" in query:
-                return self._delete_multiple(bucket, body)
+                return self._delete_multiple(bucket, self._read_body())
+            if self._is_post_policy(path, query):
+                return self._post_policy(bucket)
         raise S3Error("MethodNotAllowed")
 
     # -- service ----------------------------------------------------------
@@ -326,6 +456,60 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     errs.append((key, err.code, err.message))
         self._respond(200, xmlr.delete_result_xml(deleted, errs))
+
+    def _post_policy(self, bucket: str):
+        """Browser form upload (PostPolicyBucketHandler,
+        cmd/bucket-handlers.go): multipart/form-data with a signed,
+        base64-encoded policy document."""
+        ctype = self.headers.get("Content-Type", "")
+        boundary = ""
+        for param in ctype.split(";")[1:]:
+            k, _, v = param.strip().partition("=")
+            if k == "boundary":
+                boundary = v.strip('"')
+        if not boundary:
+            raise S3Error("MalformedPOSTRequest", "missing boundary")
+        reader, size = self._open_body()
+        if size > MAX_IN_MEMORY_BODY:
+            raise S3Error("EntityTooLarge")
+        body = b""
+        while len(body) < size:
+            c = reader.read(size - len(body))
+            if not c:
+                break
+            body += c
+        form, file_data, file_name = _parse_multipart_form(body, boundary)
+        key = form.get("key", "")
+        if not key:
+            raise S3Error("InvalidArgument", "POST requires key field")
+        key = key.replace("${filename}", file_name)
+        form["key"] = key
+        form["bucket"] = bucket
+        form["content-length"] = str(len(file_data))
+        self.s3.verifier.verify_post_policy(form)
+        meta = {}
+        if form.get("content-type"):
+            meta["content-type"] = form["content-type"]
+        for k, v in form.items():
+            if k.startswith("x-amz-meta-"):
+                meta[k] = v
+        hreader = HashReader(io.BytesIO(file_data), len(file_data))
+        info = self.s3.object_layer.put_object(
+            bucket, key, hreader, len(file_data), meta
+        )
+        status = form.get("success_action_status", "204")
+        etag_hdr = {"ETag": f'"{info.etag}"'}
+        if status == "201":
+            location = f"{self.s3.endpoint}/{bucket}/{key}"
+            self._respond(
+                201,
+                xmlr.post_response_xml(location, bucket, key, info.etag),
+                {**etag_hdr, "Location": location},
+            )
+        elif status == "200":
+            self._respond(200, b"", etag_hdr)
+        else:
+            self._respond(204, b"", etag_hdr)
 
     # -- object ops -------------------------------------------------------
 
@@ -465,19 +649,16 @@ class _Handler(BaseHTTPRequestHandler):
                 meta[lk] = v
         return meta
 
-    def _put_object(self, bucket, key, body: bytes):
-        md5_hdr = self.headers.get("Content-MD5", "")
-        md5_hex = ""
-        if md5_hdr:
-            try:
-                md5_hex = base64.b64decode(md5_hdr).hex()
-            except Exception:  # noqa: BLE001
-                raise S3Error("InvalidDigest") from None
-        reader = HashReader(
-            io.BytesIO(body), len(body), md5_hex=md5_hex
-        )
+    def _put_object(self, bucket, key):
+        """Stream the body straight into the erasure encoder in
+        block_size chunks (cmd/erasure-encode.go:73-109) - bounded memory
+        regardless of object size."""
+        reader, size = self._open_body()
+        if size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        hreader = self._hash_reader(reader, size)
         info = self.s3.object_layer.put_object(
-            bucket, key, reader, len(body), self._collect_user_metadata()
+            bucket, key, hreader, size, self._collect_user_metadata()
         )
         self._respond(200, b"", {"ETag": f'"{info.etag}"'})
 
@@ -523,14 +704,18 @@ class _Handler(BaseHTTPRequestHandler):
             200, xmlr.initiate_multipart_xml(bucket, key, uid)
         )
 
-    def _put_part(self, bucket, key, query, body):
+    def _put_part(self, bucket, key, query):
         uid = query["uploadId"][0]
         try:
             pnum = int(query["partNumber"][0])
         except ValueError:
             raise S3Error("InvalidArgument", "partNumber") from None
+        reader, size = self._open_body()
+        if size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        hreader = self._hash_reader(reader, size)
         pi = self.s3.object_layer.put_object_part(
-            bucket, key, uid, pnum, io.BytesIO(body), len(body)
+            bucket, key, uid, pnum, hreader, size
         )
         self._respond(200, b"", {"ETag": f'"{pi.etag}"'})
 
@@ -578,3 +763,45 @@ class _Handler(BaseHTTPRequestHandler):
         prefix = query.get("prefix", [""])[0]
         ups = self.s3.object_layer.list_multipart_uploads(bucket, prefix)
         self._respond(200, xmlr.list_uploads_xml(bucket, ups))
+
+
+def _parse_multipart_form(
+    body: bytes, boundary: str
+) -> "tuple[dict[str, str], bytes, str]":
+    """Parse a multipart/form-data body into (fields, file_bytes, filename).
+
+    Field names are lower-cased; only the "file" part keeps raw bytes.
+    """
+    delim = b"--" + boundary.encode()
+    fields: dict[str, str] = {}
+    file_data, file_name = b"", ""
+    for part in body.split(delim)[1:]:
+        if part in (b"--", b"--\r\n") or part.startswith(b"--"):
+            break
+        part = part.lstrip(b"\r\n")
+        head, sep, data = part.partition(b"\r\n\r\n")
+        if not sep:
+            raise S3Error("MalformedPOSTRequest", "bad form part")
+        data = data[:-2] if data.endswith(b"\r\n") else data
+        name, fname, ctype = "", "", ""
+        for line in head.split(b"\r\n"):
+            hname, _, hval = line.decode("latin-1").partition(":")
+            hname = hname.strip().lower()
+            hval = hval.strip()
+            if hname == "content-disposition":
+                for piece in hval.split(";")[1:]:
+                    pk, _, pv = piece.strip().partition("=")
+                    pv = pv.strip('"')
+                    if pk == "name":
+                        name = pv
+                    elif pk == "filename":
+                        fname = pv
+            elif hname == "content-type":
+                ctype = hval
+        if name.lower() == "file":
+            file_data, file_name = data, fname
+            if ctype and "content-type" not in fields:
+                fields["content-type"] = ctype
+        elif name:
+            fields[name.lower()] = data.decode("utf-8", "replace")
+    return fields, file_data, file_name

@@ -200,3 +200,13 @@ def delete_result_xml(deleted: list[str], errors: list[tuple]) -> bytes:
         _el(ee, "Code", code)
         _el(ee, "Message", msg)
     return _render(root)
+
+
+def post_response_xml(location, bucket, key, etag) -> bytes:
+    """201 body for POST policy uploads with success_action_status=201."""
+    root = ET.Element("PostResponse")
+    _el(root, "Location", location)
+    _el(root, "Bucket", bucket)
+    _el(root, "Key", key)
+    _el(root, "ETag", f'"{etag}"')
+    return _render(root)

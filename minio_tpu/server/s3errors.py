@@ -12,7 +12,7 @@ from http import HTTPStatus as H
 
 from ..objectlayer import api as olapi
 from ..storage import errors as serrors
-from ..utils.hashreader import BadDigest
+from ..utils.hashreader import BadDigest, SizeMismatch
 from .auth import AuthError
 
 
@@ -60,6 +60,7 @@ _E = {
     "SignatureVersionNotSupported": ("The authorization mechanism you have provided is not supported.", H.BAD_REQUEST),
     "SlowDown": ("Resource requested is unreadable, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "XAmzContentSHA256Mismatch": ("The provided 'x-amz-content-sha256' header does not match what was computed.", H.BAD_REQUEST),
+    "MalformedPOSTRequest": ("The body of your POST request is not well-formed multipart/form-data.", H.BAD_REQUEST),
     "AuthorizationHeaderMalformed": ("The authorization header is malformed.", H.BAD_REQUEST),
     "AuthorizationQueryParametersError": ("Query-string authentication parameters are malformed.", H.BAD_REQUEST),
     "NotModified": ("Not Modified", H.NOT_MODIFIED),
@@ -95,10 +96,12 @@ def from_exception(e: Exception) -> APIError:
         (olapi.InvalidUploadID, "NoSuchUpload"),
         (olapi.InvalidPartOrder, "InvalidPartOrder"),
         (olapi.InvalidPart, "InvalidPart"),
+        (olapi.EntityTooSmall, "EntityTooSmall"),
         (olapi.PreconditionFailed, "PreconditionFailed"),
         (olapi.ReadQuorumError, "SlowDown"),
         (olapi.WriteQuorumError, "SlowDown"),
         (BadDigest, "BadDigest"),
+        (SizeMismatch, "IncompleteBody"),
         (serrors.FileNotFound, "NoSuchKey"),
         (serrors.VolumeNotFound, "NoSuchBucket"),
     ]

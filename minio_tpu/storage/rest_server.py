@@ -30,9 +30,24 @@ class StorageRESTServer:
         jwt.verify(authz[len("Bearer "):], self._secret)
 
     def handle(
-        self, method_name: str, query: dict, body: bytes
+        self,
+        method_name: str,
+        query: dict,
+        body: bytes,
+        headers: "dict | None" = None,
     ) -> tuple[int, bytes, dict]:
-        """Returns (status, body, headers).  Errors use a typed envelope."""
+        """Returns (status, body, headers).  Errors use a typed envelope.
+
+        Authentication happens HERE, on the dispatch path, so no wiring
+        can mount the storage plane unauthenticated (advisor finding r1).
+        """
+        try:
+            self.authenticate(
+                {k.lower(): v for k, v in (headers or {}).items()}
+            )
+        except Exception as e:  # noqa: BLE001
+            name, msg = wire.encode_error(e)
+            return 401, wire.pack({"error": name, "message": msg}), {}
         q = {k: v[0] for k, v in query.items()}
         disk = self._disks.get(q.get("disk", ""))
         if disk is None:

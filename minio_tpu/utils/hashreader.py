@@ -16,6 +16,14 @@ class BadDigest(Exception):
         self.want, self.got = want, got
 
 
+class SizeMismatch(Exception):
+    """Fewer bytes arrived than the declared size (errIncompleteBody)."""
+
+    def __init__(self, want: int, got: int):
+        super().__init__(f"incomplete body: want {want} got {got}")
+        self.want, self.got = want, got
+
+
 class HashReader:
     def __init__(
         self,
@@ -57,6 +65,8 @@ class HashReader:
         if self._eof:
             return
         self._eof = True
+        if 0 <= self.size != self.bytes_read:
+            raise SizeMismatch(self.size, self.bytes_read)
         if self._want_md5 and self.md5_hex() != self._want_md5:
             raise BadDigest(self._want_md5, self.md5_hex())
         if self._want_sha and self._sha.hexdigest() != self._want_sha:

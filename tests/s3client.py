@@ -130,3 +130,208 @@ class S3Client:
 
     def list_objects(self, bucket, **query):
         return self.request("GET", f"/{bucket}", query=query)
+
+
+    # -- streaming SigV4 (aws-chunked) ------------------------------------
+
+    def put_object_streaming(
+        self, bucket, key, data: bytes, chunk_size: int = 64 * 1024,
+        signed: bool = True,
+    ):
+        """Upload with the aws-chunked framing the AWS SDKs/CLI use
+        (STREAMING-AWS4-HMAC-SHA256-PAYLOAD)."""
+        import hmac as hmac_mod
+
+        path = f"/{bucket}/{key}"
+        amz_date = datetime.datetime.now(
+            datetime.timezone.utc
+        ).strftime("%Y%m%dT%H%M%SZ")
+        scope = f"{amz_date[:8]}/{self.region}/s3/aws4_request"
+        payload_decl = (
+            auth.STREAMING_PAYLOAD
+            if signed
+            else auth.STREAMING_UNSIGNED_TRAILER
+        )
+        # build the encoded body
+        chunks = [
+            data[i : i + chunk_size]
+            for i in range(0, len(data), chunk_size)
+        ] + [b""]
+        headers = {
+            "host": f"{self.host}:{self.port}",
+            "x-amz-date": amz_date,
+            "x-amz-content-sha256": payload_decl,
+            "x-amz-decoded-content-length": str(len(data)),
+            "content-encoding": "aws-chunked",
+        }
+        signed_hdrs = sorted(headers)
+        sig = auth.sign_v4(
+            "PUT", path, {}, headers, signed_hdrs, payload_decl,
+            self.access_key, self.secret_key, amz_date, self.region,
+        )
+        headers["authorization"] = (
+            f"{auth.SIGN_V4_ALGORITHM} "
+            f"Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed_hdrs)}, Signature={sig}"
+        )
+        key_bytes = auth._signing_key(
+            self.secret_key, amz_date[:8], self.region, "s3"
+        )
+        prev = sig
+        body = bytearray()
+        for c in chunks:
+            if signed:
+                sts = "\n".join(
+                    [
+                        "AWS4-HMAC-SHA256-PAYLOAD",
+                        amz_date,
+                        scope,
+                        prev,
+                        auth.EMPTY_SHA256,
+                        hashlib.sha256(c).hexdigest(),
+                    ]
+                )
+                csig = hmac_mod.new(
+                    key_bytes, sts.encode(), hashlib.sha256
+                ).hexdigest()
+                prev = csig
+                body += f"{len(c):x};chunk-signature={csig}\r\n".encode()
+            else:
+                body += f"{len(c):x}\r\n".encode()
+            if c:
+                body += c + b"\r\n"
+        if not signed:
+            body += b"x-amz-checksum-crc32:AAAAAA==\r\n"
+        body += b"\r\n"
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        try:
+            conn.request("PUT", path, body=bytes(body), headers=headers)
+            resp = conn.getresponse()
+            rbody = resp.read()
+            return S3Response(
+                resp.status,
+                {k.lower(): v for k, v in resp.getheaders()},
+                rbody,
+            )
+        finally:
+            conn.close()
+
+    # -- SigV2 ------------------------------------------------------------
+
+    def request_v2(
+        self, method, path, query=None, body: bytes = b"",
+        headers=None,
+    ):
+        query = dict(query or {})
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        headers.setdefault("host", f"{self.host}:{self.port}")
+        headers.setdefault(
+            "date",
+            datetime.datetime.now(datetime.timezone.utc).strftime(
+                "%a, %d %b %Y %H:%M:%S GMT"
+            ),
+        )
+        qmap = {k: [v] for k, v in query.items()}
+        date_str = "" if "x-amz-date" in headers else headers["date"]
+        sig = auth.sign_v2(
+            method, path, qmap, headers, self.secret_key, date_str
+        )
+        headers["authorization"] = f"AWS {self.access_key}:{sig}"
+        qs = urllib.parse.urlencode(query)
+        url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        try:
+            conn.request(method, url, body=body or None, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return S3Response(
+                resp.status,
+                {k.lower(): v for k, v in resp.getheaders()},
+                data,
+            )
+        finally:
+            conn.close()
+
+    # -- POST policy ------------------------------------------------------
+
+    def post_policy_upload(
+        self, bucket, key, data: bytes, conditions=None,
+        expires_in: int = 600, extra_fields=None, status: str = "",
+    ):
+        import base64 as b64
+        import hmac as hmac_mod
+        import json
+
+        amz_date = datetime.datetime.now(
+            datetime.timezone.utc
+        ).strftime("%Y%m%dT%H%M%SZ")
+        scope = f"{amz_date[:8]}/{self.region}/s3/aws4_request"
+        credential = f"{self.access_key}/{scope}"
+        exp = (
+            datetime.datetime.now(datetime.timezone.utc)
+            + datetime.timedelta(seconds=expires_in)
+        ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+        conds = [
+            {"bucket": bucket},
+            ["eq", "$key", key],
+            {"x-amz-credential": credential},
+            {"x-amz-date": amz_date},
+            {"x-amz-algorithm": auth.SIGN_V4_ALGORITHM},
+        ] + list(conditions or [])
+        # every submitted field must be covered by a condition
+        if status:
+            conds.append({"success_action_status": status})
+        for ek, ev in (extra_fields or {}).items():
+            if ek not in ("x-amz-signature", "policy"):
+                conds.append({ek: ev})
+        policy = b64.b64encode(
+            json.dumps({"expiration": exp, "conditions": conds}).encode()
+        ).decode()
+        key_bytes = auth._signing_key(
+            self.secret_key, amz_date[:8], self.region, "s3"
+        )
+        sig = hmac_mod.new(
+            key_bytes, policy.encode(), hashlib.sha256
+        ).hexdigest()
+        fields = {
+            "key": key,
+            "policy": policy,
+            "x-amz-algorithm": auth.SIGN_V4_ALGORITHM,
+            "x-amz-credential": credential,
+            "x-amz-date": amz_date,
+            "x-amz-signature": sig,
+        }
+        if status:
+            fields["success_action_status"] = status
+        fields.update(extra_fields or {})
+        boundary = "----tpuboundary42"
+        body = bytearray()
+        for fk, fv in fields.items():
+            body += (
+                f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="{fk}"\r\n\r\n{fv}\r\n'
+            ).encode()
+        body += (
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="file"; filename="upload.bin"\r\n'
+            f"Content-Type: application/octet-stream\r\n\r\n"
+        ).encode()
+        body += data + f"\r\n--{boundary}--\r\n".encode()
+        headers = {
+            "host": f"{self.host}:{self.port}",
+            "content-type": f"multipart/form-data; boundary={boundary}",
+        }
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        try:
+            conn.request(
+                "POST", f"/{bucket}", body=bytes(body), headers=headers
+            )
+            resp = conn.getresponse()
+            rbody = resp.read()
+            return S3Response(
+                resp.status,
+                {k.lower(): v for k, v in resp.getheaders()},
+                rbody,
+            )
+        finally:
+            conn.close()

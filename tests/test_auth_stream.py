@@ -1,0 +1,525 @@
+"""Auth long-tail conformance: streaming SigV4 (aws-chunked), SigV2,
+presigned V2, POST policy uploads, and body-framing edge cases.
+
+The black-box analogue of cmd/streaming-signature-v4_test.go,
+signature-v2 cases in cmd/auth-handler_test.go, and the mint awscli /
+s3cmd (SigV2) groups.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+BLOCK = 4096
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("disks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = S3Client(server.endpoint)
+    c.make_bucket("authx")
+    return c
+
+
+def _pay(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8
+    ).tobytes()
+
+
+# -- streaming SigV4 ------------------------------------------------------
+
+
+def test_streaming_signed_put(client):
+    data = _pay(3 * BLOCK + 777, seed=1)
+    r = client.put_object_streaming("authx", "chunked", data)
+    assert r.status == 200, r.body
+    g = client.get_object("authx", "chunked")
+    assert g.status == 200 and g.body == data
+    assert g.headers["etag"] == f'"{hashlib.md5(data).hexdigest()}"'
+
+
+def test_streaming_signed_put_multi_chunk(client):
+    data = _pay(300 * 1024, seed=2)  # several 64 KiB chunks
+    r = client.put_object_streaming(
+        "authx", "chunked2", data, chunk_size=64 * 1024
+    )
+    assert r.status == 200, r.body
+    g = client.get_object("authx", "chunked2")
+    assert g.body == data
+
+
+def test_streaming_unsigned_trailer_put(client):
+    data = _pay(2 * BLOCK + 9, seed=3)
+    r = client.put_object_streaming(
+        "authx", "trailer", data, signed=False
+    )
+    assert r.status == 200, r.body
+    assert client.get_object("authx", "trailer").body == data
+
+
+def test_streaming_bad_chunk_signature(server):
+    """Corrupting one chunk's data must fail its chunk signature."""
+    import http.client as hc
+
+    import datetime as dt
+
+    from minio_tpu.server import auth
+
+    c = S3Client(server.endpoint)
+    data = _pay(BLOCK, seed=4)
+    # sign correctly, then flip a byte in the chunk payload
+    path = "/authx/badchunk"
+    amz_date = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    scope = f"{amz_date[:8]}/{c.region}/s3/aws4_request"
+    headers = {
+        "host": f"{c.host}:{c.port}",
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": auth.STREAMING_PAYLOAD,
+        "x-amz-decoded-content-length": str(len(data)),
+    }
+    signed_hdrs = sorted(headers)
+    sig = auth.sign_v4(
+        "PUT", path, {}, headers, signed_hdrs, auth.STREAMING_PAYLOAD,
+        c.access_key, c.secret_key, amz_date, c.region,
+    )
+    headers["authorization"] = (
+        f"{auth.SIGN_V4_ALGORITHM} "
+        f"Credential={c.access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed_hdrs)}, Signature={sig}"
+    )
+    import hmac as hm
+
+    kb = auth._signing_key(c.secret_key, amz_date[:8], c.region, "s3")
+    sts = "\n".join(
+        [
+            "AWS4-HMAC-SHA256-PAYLOAD",
+            amz_date,
+            scope,
+            sig,
+            auth.EMPTY_SHA256,
+            hashlib.sha256(data).hexdigest(),
+        ]
+    )
+    csig = hm.new(kb, sts.encode(), hashlib.sha256).hexdigest()
+    corrupted = bytearray(data)
+    corrupted[0] ^= 0xFF
+    body = (
+        f"{len(data):x};chunk-signature={csig}\r\n".encode()
+        + bytes(corrupted)
+        + b"\r\n0;chunk-signature=deadbeef\r\n\r\n"
+    )
+    conn = hc.HTTPConnection(c.host, c.port, timeout=30)
+    try:
+        conn.request("PUT", path, body=body, headers=headers)
+        resp = conn.getresponse()
+        rbody = resp.read()
+        assert resp.status == 403
+        assert b"SignatureDoesNotMatch" in rbody
+    finally:
+        conn.close()
+    # and the object must not exist
+    assert client_head_404(c, "authx", "badchunk")
+
+
+def client_head_404(c, bucket, key):
+    return c.head_object(bucket, key).status == 404
+
+
+# -- SigV2 ----------------------------------------------------------------
+
+
+def test_sigv2_roundtrip(client, server):
+    c = S3Client(server.endpoint)
+    data = _pay(BLOCK + 5, seed=5)
+    r = c.request_v2("PUT", "/authx/v2obj", body=data)
+    assert r.status == 200, r.body
+    g = c.request_v2("GET", "/authx/v2obj")
+    assert g.status == 200 and g.body == data
+    # wrong secret fails
+    bad = S3Client(server.endpoint, secret_key="wrong-secret")
+    r = bad.request_v2("GET", "/authx/v2obj")
+    assert r.status == 403
+    assert r.error_code == "SignatureDoesNotMatch"
+
+
+def test_sigv2_subresource_canonicalization(client, server):
+    """uploads / uploadId must enter the V2 canonical resource."""
+    c = S3Client(server.endpoint)
+    r = c.request_v2("POST", "/authx/v2mp", query={"uploads": ""})
+    assert r.status == 200, r.body
+    uid = r.xml_text("UploadId")
+    r = c.request_v2(
+        "DELETE", "/authx/v2mp", query={"uploadId": uid}
+    )
+    assert r.status == 204
+
+
+def test_sigv2_presigned(server):
+    import time
+    import urllib.parse as up
+
+    import http.client as hc
+
+    from minio_tpu.server import auth as a
+
+    c = S3Client(server.endpoint)
+    data = _pay(128, seed=6)
+    c.put_object("authx", "v2pre", data)
+    expires = str(int(time.time()) + 600)
+    qmap = {
+        "AWSAccessKeyId": [c.access_key],
+        "Expires": [expires],
+    }
+    sig = a.sign_v2(
+        "GET", "/authx/v2pre", qmap, {}, c.secret_key, expires
+    )
+    qs = up.urlencode(
+        {
+            "AWSAccessKeyId": c.access_key,
+            "Expires": expires,
+            "Signature": sig,
+        }
+    )
+    conn = hc.HTTPConnection(c.host, c.port, timeout=30)
+    try:
+        conn.request("GET", f"/authx/v2pre?{qs}")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200
+        assert body == data
+    finally:
+        conn.close()
+
+
+# -- POST policy ----------------------------------------------------------
+
+
+def test_post_policy_upload(client):
+    data = _pay(BLOCK * 2, seed=7)
+    r = client.post_policy_upload("authx", "posted", data)
+    assert r.status == 204, r.body
+    assert client.get_object("authx", "posted").body == data
+
+
+def test_post_policy_201_response(client):
+    data = _pay(64, seed=8)
+    r = client.post_policy_upload(
+        "authx", "posted201", data, status="201"
+    )
+    assert r.status == 201
+    assert r.xml_text("Key") == "posted201"
+    assert client.get_object("authx", "posted201").body == data
+
+
+def test_post_policy_content_length_range(client):
+    data = _pay(4096, seed=9)
+    r = client.post_policy_upload(
+        "authx", "toolarge", data,
+        conditions=[["content-length-range", 1, 100]],
+    )
+    assert r.status == 400
+    assert r.error_code == "EntityTooLarge"
+    assert client.head_object("authx", "toolarge").status == 404
+
+
+def test_post_policy_condition_mismatch(client):
+    data = _pay(32, seed=10)
+    r = client.post_policy_upload(
+        "authx", "mismatch", data,
+        conditions=[["eq", "$x-amz-meta-tag", "expected"]],
+    )
+    assert r.status == 403
+    assert r.error_code == "AccessDenied"
+
+
+def test_post_policy_expired(client):
+    data = _pay(32, seed=11)
+    r = client.post_policy_upload(
+        "authx", "expired", data, expires_in=-60
+    )
+    assert r.status == 403
+
+
+def test_post_policy_bad_signature(client):
+    data = _pay(32, seed=12)
+    r = client.post_policy_upload(
+        "authx", "badsig", data,
+        extra_fields={"x-amz-signature": "0" * 64},
+    )
+    assert r.status == 403
+    assert r.error_code == "SignatureDoesNotMatch"
+
+
+# -- body framing ---------------------------------------------------------
+
+
+def test_chunked_te_rejected(server):
+    """Transfer-Encoding: chunked (plain HTTP chunking) -> 411
+    MissingContentLength (advisor finding r1: was treated as empty)."""
+    import http.client as hc
+
+    conn = hc.HTTPConnection(server.host, server.port, timeout=10)
+    try:
+        conn.putrequest("PUT", "/authx/chunky")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        conn.send(b"5\r\nhello\r\n0\r\n\r\n")
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 411
+        assert b"MissingContentLength" in body
+    finally:
+        conn.close()
+
+
+def test_put_without_content_length_rejected(server):
+    import socket
+
+    raw = socket.create_connection(
+        (server.host, server.port), timeout=10
+    )
+    try:
+        raw.sendall(
+            b"PUT /authx/nolen HTTP/1.1\r\n"
+            b"Host: x\r\n\r\n"
+        )
+        resp = raw.recv(65536)
+        assert b"411" in resp.split(b"\r\n", 1)[0]
+    finally:
+        raw.close()
+
+
+def test_content_md5_mismatch_rejected(client):
+    import base64
+
+    data = _pay(256, seed=13)
+    wrong = base64.b64encode(hashlib.md5(b"other").digest()).decode()
+    r = client.put_object(
+        "authx", "badmd5", data, headers={"Content-MD5": wrong}
+    )
+    assert r.status == 400
+    assert r.error_code == "BadDigest"
+    assert client.head_object("authx", "badmd5").status == 404
+
+
+def test_multipart_entity_too_small(client):
+    """Non-final parts below 5 MiB are rejected at complete time
+    (advisor finding r1)."""
+    r = client.request("POST", "/authx/small-mp", query={"uploads": ""})
+    uid = r.xml_text("UploadId")
+    etags = {}
+    for pn in (1, 2):
+        pr = client.request(
+            "PUT",
+            "/authx/small-mp",
+            query={"partNumber": str(pn), "uploadId": uid},
+            body=_pay(1024, seed=pn),
+        )
+        assert pr.status == 200
+        etags[pn] = pr.headers["etag"].strip('"')
+    body = (
+        "<CompleteMultipartUpload>"
+        + "".join(
+            f"<Part><PartNumber>{pn}</PartNumber>"
+            f"<ETag>{etags[pn]}</ETag></Part>"
+            for pn in (1, 2)
+        )
+        + "</CompleteMultipartUpload>"
+    ).encode()
+    r = client.request(
+        "POST", "/authx/small-mp", query={"uploadId": uid}, body=body
+    )
+    assert r.status == 400
+    assert r.error_code == "EntityTooSmall"
+
+
+def test_streaming_truncated_body_incomplete(server):
+    """Declared decoded length > actual chunks -> IncompleteBody, no
+    object created (review finding r2)."""
+    import datetime as dt
+    import hmac as hm
+    import http.client as hc
+
+    from minio_tpu.server import auth
+
+    c = S3Client(server.endpoint)
+    data = _pay(512, seed=20)
+    path = "/authx/trunc"
+    amz = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    scope = f"{amz[:8]}/{c.region}/s3/aws4_request"
+    headers = {
+        "host": f"{c.host}:{c.port}",
+        "x-amz-date": amz,
+        "x-amz-content-sha256": auth.STREAMING_PAYLOAD,
+        # declare twice the actual payload
+        "x-amz-decoded-content-length": str(len(data) * 2),
+    }
+    sh = sorted(headers)
+    sig = auth.sign_v4(
+        "PUT", path, {}, headers, sh, auth.STREAMING_PAYLOAD,
+        c.access_key, c.secret_key, amz, c.region,
+    )
+    headers["authorization"] = (
+        f"{auth.SIGN_V4_ALGORITHM} Credential={c.access_key}/{scope}, "
+        f"SignedHeaders={';'.join(sh)}, Signature={sig}"
+    )
+    kb = auth._signing_key(c.secret_key, amz[:8], c.region, "s3")
+
+    def chunk_sig(prev, payload):
+        sts = "\n".join(
+            [
+                "AWS4-HMAC-SHA256-PAYLOAD", amz, scope, prev,
+                auth.EMPTY_SHA256,
+                hashlib.sha256(payload).hexdigest(),
+            ]
+        )
+        return hm.new(kb, sts.encode(), hashlib.sha256).hexdigest()
+
+    s1 = chunk_sig(sig, data)
+    s2 = chunk_sig(s1, b"")
+    body = (
+        f"{len(data):x};chunk-signature={s1}\r\n".encode()
+        + data
+        + b"\r\n"
+        + f"0;chunk-signature={s2}\r\n\r\n".encode()
+    )
+    conn = hc.HTTPConnection(c.host, c.port, timeout=30)
+    try:
+        conn.request("PUT", path, body=body, headers=headers)
+        resp = conn.getresponse()
+        rbody = resp.read()
+        assert resp.status == 400, rbody
+        assert b"IncompleteBody" in rbody
+    finally:
+        conn.close()
+    assert c.head_object("authx", "trunc").status == 404
+
+
+def test_post_policy_uncovered_field_rejected(client):
+    """Form fields not pinned by a policy condition are refused
+    (review finding r2: metadata smuggling)."""
+    data = _pay(32, seed=21)
+    import json as js
+    import base64 as b64
+
+    from minio_tpu.server import auth as a
+    import datetime as dt
+    import hmac as hm
+    import http.client as hc
+
+    amz = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    scope = f"{amz[:8]}/{client.region}/s3/aws4_request"
+    credential = f"{client.access_key}/{scope}"
+    exp = (
+        dt.datetime.now(dt.timezone.utc) + dt.timedelta(seconds=600)
+    ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+    conds = [
+        {"bucket": "authx"},
+        ["eq", "$key", "smuggle"],
+        {"x-amz-credential": credential},
+        {"x-amz-date": amz},
+        {"x-amz-algorithm": a.SIGN_V4_ALGORITHM},
+    ]
+    policy = b64.b64encode(
+        js.dumps({"expiration": exp, "conditions": conds}).encode()
+    ).decode()
+    kb = a._signing_key(
+        client.secret_key, amz[:8], client.region, "s3"
+    )
+    sig = hm.new(kb, policy.encode(), hashlib.sha256).hexdigest()
+    fields = {
+        "key": "smuggle",
+        "policy": policy,
+        "x-amz-algorithm": a.SIGN_V4_ALGORITHM,
+        "x-amz-credential": credential,
+        "x-amz-date": amz,
+        "x-amz-signature": sig,
+        "x-amz-meta-evil": "1",  # NOT covered by any condition
+    }
+    boundary = "----smuggleboundary"
+    body = bytearray()
+    for fk, fv in fields.items():
+        body += (
+            f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="{fk}"\r\n\r\n{fv}\r\n'
+        ).encode()
+    body += (
+        f"--{boundary}\r\nContent-Disposition: form-data; "
+        f'name="file"; filename="f"\r\n\r\n'
+    ).encode()
+    body += data + f"\r\n--{boundary}--\r\n".encode()
+    conn = hc.HTTPConnection(client.host, client.port, timeout=30)
+    try:
+        conn.request(
+            "POST", "/authx",
+            body=bytes(body),
+            headers={
+                "host": f"{client.host}:{client.port}",
+                "content-type": (
+                    f"multipart/form-data; boundary={boundary}"
+                ),
+            },
+        )
+        resp = conn.getresponse()
+        rbody = resp.read()
+        assert resp.status == 403, rbody
+        assert b"AccessDenied" in rbody
+    finally:
+        conn.close()
+    assert client.head_object("authx", "smuggle").status == 404
+
+
+def test_streaming_oversize_chunk_header_bounded(server):
+    """A CRLF-less flood must be cut off by the 4 KiB line cap, not
+    buffered (review finding r2: unbounded buffering)."""
+    import http.client as hc
+
+    import datetime as dt
+
+    from minio_tpu.server import auth
+
+    c = S3Client(server.endpoint)
+    amz = dt.datetime.now(dt.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    scope = f"{amz[:8]}/{c.region}/s3/aws4_request"
+    headers = {
+        "host": f"{c.host}:{c.port}",
+        "x-amz-date": amz,
+        "x-amz-content-sha256": auth.STREAMING_PAYLOAD,
+        "x-amz-decoded-content-length": "1048576",
+    }
+    sh = sorted(headers)
+    sig = auth.sign_v4(
+        "PUT", "/authx/flood", {}, headers, sh, auth.STREAMING_PAYLOAD,
+        c.access_key, c.secret_key, amz, c.region,
+    )
+    headers["authorization"] = (
+        f"{auth.SIGN_V4_ALGORITHM} Credential={c.access_key}/{scope}, "
+        f"SignedHeaders={';'.join(sh)}, Signature={sig}"
+    )
+    flood = b"a" * (256 * 1024)  # no CRLF anywhere
+    conn = hc.HTTPConnection(c.host, c.port, timeout=30)
+    try:
+        conn.request("PUT", "/authx/flood", body=flood, headers=headers)
+        resp = conn.getresponse()
+        rbody = resp.read()
+        assert resp.status == 400
+        assert b"IncompleteBody" in rbody
+    finally:
+        conn.close()

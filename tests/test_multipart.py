@@ -17,7 +17,7 @@ BLOCK = 4096
 @pytest.fixture
 def ol(tmp_path):
     disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
-    layer = ErasureObjects(disks, block_size=BLOCK)
+    layer = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
     layer.make_bucket("bucket")
     return layer
 

@@ -24,7 +24,7 @@ BLOCK = 4096
 def server(tmp_path_factory):
     root = tmp_path_factory.mktemp("disks")
     disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
-    ol = ErasureObjects(disks, block_size=BLOCK)
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
     srv = S3Server(ol, address="127.0.0.1:0").start()
     yield srv
     srv.shutdown()

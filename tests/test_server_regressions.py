@@ -18,7 +18,7 @@ BLOCK = 4096
 def server(tmp_path_factory):
     root = tmp_path_factory.mktemp("disks")
     disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
-    ol = ErasureObjects(disks, block_size=BLOCK)
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
     srv = S3Server(ol, address="127.0.0.1:0").start()
     yield srv
     srv.shutdown()
@@ -120,7 +120,12 @@ def test_malformed_list_params(client):
 
 
 def test_oversize_put_connection_close(server):
-    """Finding 3: rejecting an unread body must not desync keep-alive."""
+    """Finding 3: rejecting an unread body must not desync keep-alive.
+
+    PUTs stream now (no in-memory cap), so the unsigned giant PUT is
+    refused at auth time - but the connection must still be closed
+    rather than misparsing the (never-sent) body as a next request.
+    """
     import http.client
 
     conn = http.client.HTTPConnection(
@@ -132,10 +137,8 @@ def test_oversize_put_connection_close(server):
         conn.endheaders()
         resp = conn.getresponse()
         body = resp.read()
-        assert resp.status == 400
-        assert b"EntityTooLarge" in body
-        # server must close the connection rather than misparse the
-        # (never-sent) body as a next request
+        assert resp.status == 403
+        assert b"AccessDenied" in body
         assert resp.getheader("Connection") == "close" or resp.isclosed()
     finally:
         conn.close()
