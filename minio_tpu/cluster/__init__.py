@@ -1,0 +1,1 @@
+"""Cluster topology + internode planes (L0 of the layer map)."""

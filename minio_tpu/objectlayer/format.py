@@ -106,6 +106,62 @@ def init_format_erasure(
     return ref
 
 
+def wait_for_format(
+    disks: list,
+    set_count: int,
+    drives_per_set: int,
+    init_allowed: bool = True,
+    timeout_s: float = 120.0,
+    poll_s: float = 1.0,
+) -> tuple["FormatErasure", list]:
+    """Boot retry loop over possibly-remote disks
+    (waitForFormatErasure, prepare-storage.go:350).
+
+    Unreachable disks do NOT count as fresh - a fully fresh cluster is
+    only initialized when every disk is reachable, and only by the node
+    owning the first endpoint (init_allowed), so concurrent first boots
+    cannot mint two deployments.  A formatted quorum proceeds with
+    offline disks passed as None (healed later).
+    """
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    last = "no probe yet"
+    while True:
+        fmts: list = []  # FormatErasure | None (fresh) | False (offline)
+        for d in disks:
+            try:
+                fmts.append(read_format(d))
+            except serrors.CorruptedFormat:
+                raise
+            except Exception:  # noqa: BLE001 - unreachable remote
+                fmts.append(False)
+        n_offline = sum(1 for f in fmts if f is False)
+        live = [f for f in fmts if f]
+        if not live:
+            if n_offline == 0 and init_allowed:
+                return load_or_init_format(
+                    disks, set_count, drives_per_set
+                )
+            last = (
+                f"fresh cluster: {n_offline} unreachable, "
+                f"init_allowed={init_allowed}"
+            )
+        elif len(live) > len(disks) // 2:
+            use = [
+                None if f is False else d
+                for d, f in zip(disks, fmts)
+            ]
+            return load_or_init_format(use, set_count, drives_per_set)
+        else:
+            last = f"format quorum {len(live)}/{len(disks)} not reached"
+        if _time.monotonic() >= deadline:
+            raise serrors.UnformattedDisk(
+                f"timed out waiting for format: {last}"
+            )
+        _time.sleep(poll_s)
+
+
 def load_or_init_format(
     disks: list, set_count: int, drives_per_set: int
 ) -> tuple[FormatErasure, list]:

@@ -1,11 +1,16 @@
 """CLI entry: ``python -m minio_tpu.server [--address host:port] args...``
 
 The `minio server` analogue (cmd/server-main.go): each positional arg is
-one zone; ellipses patterns expand to that zone's drives
-(``/data/disk{1...8}``), drives are partitioned into erasure sets
-(endpoint-ellipses.go GCD math), format.json is created/quorum-loaded per
-zone, and the object layer is Zones(Sets(Objects)) exactly like
-newObjectLayer (server-main.go:559-567).
+one zone; ellipses patterns expand to that zone's drives - bare paths
+(``/data/disk{1...8}``) for single-node mode or URLs
+(``http://host{1...2}:9000/data/disk{1...4}``) for distributed mode.
+Local drives are served to peers over the storage REST plane; remote
+drives are reached through StorageRESTClient; format.json is
+created/quorum-loaded per zone with a boot retry loop, and the object
+layer is Zones(Sets(Objects)) exactly like newObjectLayer
+(server-main.go:559-567).  HTTP serving starts before the object layer
+is ready (503 ServerNotInitialized until then), mirroring
+server-main.go:477-484.
 """
 
 from __future__ import annotations
@@ -17,31 +22,69 @@ import sys
 
 
 def build_object_layer(zone_args: list[str], parity: "int | None" = None):
-    """Expand args -> formatted, ordered disks -> zones object layer."""
-    from ..objectlayer.format import load_or_init_format
+    """Single-node convenience: expand bare-path args -> zones layer."""
+    ol, _ = build_cluster(zone_args, local_port=0, secret="", parity=parity)
+    return ol
+
+
+def build_cluster(
+    zone_args: list[str],
+    local_port: int,
+    secret: str,
+    parity: "int | None" = None,
+    format_timeout_s: float = 120.0,
+    local_disk_map: "dict | None" = None,
+):
+    """Expand args -> local XLStorage + remote REST disks -> zones layer.
+
+    Returns (object_layer, local_disks) where local_disks is every
+    XLStorage this node owns (to be served on the storage REST plane).
+    """
+    from ..cluster.endpoints import resolve_endpoints
+    from ..objectlayer.format import wait_for_format
     from ..objectlayer.sets import ErasureSets
     from ..objectlayer.zones import ErasureZones
+    from ..storage.rest_client import StorageRESTClient
     from ..storage.xl import XLStorage
     from ..utils import ellipses
 
     zones = []
+    local_disks: list = []
     for zarg in zone_args:
-        paths = ellipses.expand(zarg)
-        if len(paths) < 2:
+        specs = ellipses.expand(zarg)
+        eps = resolve_endpoints(specs, local_port)
+        if len(eps) < 2:
             raise SystemExit(
-                f"zone {zarg!r} expands to {len(paths)} drives; need >= 2"
+                f"zone {zarg!r} expands to {len(eps)} drives; need >= 2"
             )
-        set_count, drives_per_set = ellipses.layout(len(paths))
-        disks = [XLStorage(p) for p in paths]
-        _, ordered = load_or_init_format(
-            disks, set_count, drives_per_set
+        set_count, drives_per_set = ellipses.layout(len(eps))
+        disks = []
+        for ep in eps:
+            if ep.is_local:
+                d = (local_disk_map or {}).get(ep.path)
+                if d is None:
+                    d = XLStorage(ep.path, endpoint=ep.raw)
+                local_disks.append(d)
+                disks.append(d)
+            else:
+                disks.append(
+                    StorageRESTClient(ep.host, ep.port, ep.path, secret)
+                )
+        # only the owner of the first endpoint may mint a fresh cluster
+        init_allowed = eps[0].is_local
+        _, ordered = wait_for_format(
+            disks,
+            set_count,
+            drives_per_set,
+            init_allowed=init_allowed,
+            timeout_s=format_timeout_s,
         )
         zones.append(
             ErasureSets(
                 ordered, set_count, drives_per_set, parity_blocks=parity
             )
         )
-    return ErasureZones(zones)
+    return ErasureZones(zones), local_disks
 
 
 def main(argv=None) -> int:
@@ -49,7 +92,10 @@ def main(argv=None) -> int:
     p.add_argument(
         "zones",
         nargs="+",
-        help="one arg per zone; ellipses expand: /data/disk{1...8}",
+        help=(
+            "one arg per zone; ellipses expand: /data/disk{1...8} or "
+            "http://host{1...2}:9000/data/disk{1...4}"
+        ),
     )
     p.add_argument("--address", default="0.0.0.0:9000")
     p.add_argument(
@@ -65,23 +111,60 @@ def main(argv=None) -> int:
         "--parity", type=int, default=None,
         help="parity drives per set (default: half)",
     )
+    p.add_argument(
+        "--format-timeout", type=float, default=120.0,
+        help="seconds to wait for peers during format bootstrap",
+    )
     args = p.parse_args(argv)
 
+    from ..cluster.endpoints import resolve_endpoints
+    from ..storage.rest_server import StorageRESTServer
+    from ..storage.rest_common import PREFIX as STORAGE_PREFIX
+    from ..storage.xl import XLStorage
+    from ..utils import ellipses
     from .http import S3Server
 
-    ol = build_object_layer(args.zones, args.parity)
+    local_port = int(args.address.rsplit(":", 1)[1])
+
+    # Discover local drives first so the storage plane can serve peers
+    # BEFORE format bootstrap (reference starts HTTP at
+    # server-main.go:477, then waits for disks).
+    pre_local: list = []
+    local_map: dict = {}
+    for zarg in args.zones:
+        for ep in resolve_endpoints(ellipses.expand(zarg), local_port):
+            if ep.is_local:
+                d = XLStorage(ep.path, endpoint=ep.raw)
+                pre_local.append(d)
+                local_map[ep.path] = d
+
     srv = S3Server(
-        ol,
+        None,  # object layer attaches after bootstrap
         address=args.address,
         access_key=args.access_key,
         secret_key=args.secret_key,
         region=args.region,
-    ).start()
+    )
+    storage_rest = StorageRESTServer(pre_local, args.secret_key)
+    srv.register_internode(STORAGE_PREFIX, storage_rest.handle)
+    srv.start()
+    print(f"minio-tpu listening at {srv.endpoint} (bootstrapping)")
+
+    ol, _ = build_cluster(
+        args.zones,
+        local_port,
+        args.secret_key,
+        args.parity,
+        format_timeout_s=args.format_timeout,
+        local_disk_map=local_map,
+    )
+    srv.object_layer = ol
     si = ol.storage_info()
     print(
         f"minio-tpu serving {len(ol.zones)} zone(s) "
         f"{[z['disks'] for z in si['zones']]} drives at {srv.endpoint}"
     )
+    sys.stdout.flush()
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}, shutting down")
     srv.shutdown()

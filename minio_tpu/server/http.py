@@ -89,6 +89,15 @@ class S3Server:
         self.verifier = SigV4Verifier(lookup, region)
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
+        # internode planes (storage/lock/peer/bootstrap REST, the
+        # registerDistErasureRouters analogue, routers.go:25-38):
+        # prefix -> handler(method_tail, query, body, headers)
+        #           returning (status, body, extra_headers)
+        self.internode: "dict[str, object]" = {}
+
+    def register_internode(self, prefix: str, handler) -> None:
+        """Mount an internode REST plane under a path prefix."""
+        self.internode[prefix] = handler
 
     # -- lifecycle --------------------------------------------------------
 
@@ -286,6 +295,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._headers_sent = False
         self._raw_body = None
         self._auth = None
+        for prefix, handler in self.s3.internode.items():
+            if path.startswith(prefix + "/"):
+                return self._route_internode(
+                    handler, path[len(prefix) + 1 :], query
+                )
         try:
             # body-framing validity precedes auth, matching the generic
             # middleware order (requestValidityHandler, routers.go:41-79)
@@ -312,6 +326,25 @@ class _Handler(BaseHTTPRequestHandler):
 
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = route
 
+    def _route_internode(self, handler, method_tail: str, query) -> None:
+        """Dispatch an internode-plane request (JWT auth happens inside
+        the plane handler, storage-rest-server.go:63-104)."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            status, payload, extra = handler(
+                method_tail, query, body, dict(self.headers.items())
+            )
+        except Exception as e:  # noqa: BLE001
+            self.close_connection = True
+            self._respond(
+                500, str(e).encode(), content_type="text/plain"
+            )
+            return
+        self._respond(
+            status, payload, extra, content_type="application/octet-stream"
+        )
+
     # -- dispatch (api-router.go route table) -----------------------------
 
     def _dispatch(self, path: str, query):
@@ -320,6 +353,8 @@ class _Handler(BaseHTTPRequestHandler):
         key = parts[1] if len(parts) > 1 else ""
         m = self.command
         ol = self.s3.object_layer
+        if ol is None:  # still bootstrapping (server-main.go safe mode)
+            raise S3Error("ServerNotInitialized")
 
         if not bucket:
             if m == "GET":

@@ -58,6 +58,7 @@ _E = {
     "RequestTimeTooSkewed": ("The difference between the request time and the server's time is too large.", H.FORBIDDEN),
     "SignatureDoesNotMatch": ("The request signature we calculated does not match the signature you provided.", H.FORBIDDEN),
     "SignatureVersionNotSupported": ("The authorization mechanism you have provided is not supported.", H.BAD_REQUEST),
+    "ServerNotInitialized": ("Server not initialized, please try again.", H.SERVICE_UNAVAILABLE),
     "SlowDown": ("Resource requested is unreadable, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "XAmzContentSHA256Mismatch": ("The provided 'x-amz-content-sha256' header does not match what was computed.", H.BAD_REQUEST),
     "MalformedPOSTRequest": ("The body of your POST request is not well-formed multipart/form-data.", H.BAD_REQUEST),

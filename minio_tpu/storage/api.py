@@ -122,6 +122,14 @@ class StorageAPI:
     def create_file(self, volume: str, path: str) -> ShardWriter:
         raise NotImplementedError
 
+    def append_file(
+        self, volume: str, path: str, data: bytes, truncate: bool = False
+    ) -> None:
+        """Append a chunk to a shard file (the storage REST plane's
+        bounded-memory CreateFile stream; truncate=True on the first
+        chunk creates/resets the file)."""
+        raise NotImplementedError
+
     def read_file_stream(self, volume: str, path: str) -> ShardReader:
         raise NotImplementedError
 

@@ -1,0 +1,373 @@
+"""Remote StorageAPI over the storage REST plane
+(cmd/storage-rest-client.go:671, cmd/rest/client.go).
+
+Every method is one HTTP POST to the peer's
+``/minio-tpu/storage/v1/<method>`` with query args and a msgpack or raw
+body, authenticated by a short-lived internode JWT.  Typed errors travel
+in a msgpack envelope and are re-raised as the same exception classes a
+local disk raises, so quorum accounting (reduce_errs) cannot tell local
+and remote disks apart.
+
+Connection failures mark the disk offline; is_online() re-probes after a
+backoff, mirroring the lazy reconnect of storage-rest-client.go:677.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+import urllib.parse
+
+from ..utils import jwt
+from . import rest_common as wire
+from .api import (
+    DiskInfo,
+    ShardReader,
+    ShardWriter,
+    StatInfo,
+    StorageAPI,
+    VolInfo,
+)
+from .errors import DiskNotFound
+from .meta import FileInfo, XLMeta
+
+_RECONNECT_S = 3.0  # defaultRetryUnit-ish probe backoff
+_TOKEN_TTL_S = 900
+_WRITE_BUF = 4 << 20  # shard bytes buffered before an appendfile POST
+
+
+class RemoteShardWriter(ShardWriter):
+    """Buffers shard bytes and appends them to the remote file in
+    bounded flushes (the CreateFile streaming POST analogue)."""
+
+    def __init__(self, client: "StorageRESTClient", volume: str, path: str):
+        self._c = client
+        self._vol = volume
+        self._path = path
+        self._buf = bytearray()
+        self._first = True
+
+    def _flush(self) -> None:
+        q = {"vol": self._vol, "path": self._path}
+        if self._first:
+            q["truncate"] = "1"
+            self._first = False
+        self._c._call("appendfile", q, bytes(self._buf))
+        del self._buf[:]
+
+    def write(self, data: bytes) -> None:
+        self._buf += data
+        if len(self._buf) >= _WRITE_BUF:
+            self._flush()
+
+    def close(self) -> None:
+        if self._buf or self._first:
+            self._flush()
+
+
+class RemoteShardReader(ShardReader):
+    def __init__(self, client: "StorageRESTClient", volume: str, path: str):
+        self._c = client
+        self._vol = volume
+        self._path = path
+        # fail fast like the local open() does
+        self._c._call(
+            "statfile", {"vol": volume, "path": path}
+        )
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        return self._c._call(
+            "readfilestream",
+            {
+                "vol": self._vol,
+                "path": self._path,
+                "offset": str(offset),
+                "length": str(length),
+            },
+        )
+
+    def close(self) -> None:
+        pass
+
+
+class StorageRESTClient(StorageAPI):
+    """StorageAPI for one remote drive served by a peer node."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        disk_path: str,
+        secret: str,
+        access_key: str = "minio-tpu-node",
+        timeout: float = 30.0,
+    ):
+        self.host = host
+        self.port = port
+        self.disk_path = disk_path
+        self.root = disk_path  # REST server keys disks by root path
+        self._secret = secret
+        self._access_key = access_key
+        self._timeout = timeout
+        self._endpoint = f"http://{host}:{port}{disk_path}"
+        self._local = threading.local()
+        self._token = ""
+        self._token_exp = 0.0
+        self._online = True
+        self._last_probe = 0.0
+        self._disk_id = ""
+
+    # ---- transport ------------------------------------------------------
+
+    def _bearer(self) -> str:
+        now = time.time()
+        if now > self._token_exp - 60:
+            self._token = jwt.sign(
+                {"sub": self._access_key}, self._secret, _TOKEN_TTL_S
+            )
+            self._token_exp = now + _TOKEN_TTL_S
+        return self._token
+
+    def _conn(self) -> http.client.HTTPConnection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(
+                self.host, self.port, timeout=self._timeout
+            )
+            self._local.conn = c
+        return c
+
+    def _drop_conn(self) -> None:
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._local.conn = None
+
+    def _call(
+        self, method: str, q: "dict | None" = None, body: bytes = b""
+    ) -> bytes:
+        if not self._online and not self._should_probe():
+            raise DiskNotFound(f"{self._endpoint} offline")
+        query = {"disk": self.disk_path}
+        query.update(q or {})
+        url = f"{wire.PREFIX}/{method}?" + urllib.parse.urlencode(query)
+        headers = {
+            "Authorization": f"Bearer {self._bearer()}",
+            "Content-Length": str(len(body)),
+        }
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request("POST", url, body=body or None, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                break
+            except (OSError, http.client.HTTPException):
+                # one retry on a fresh connection (stale keep-alive)
+                self._drop_conn()
+                if attempt:
+                    self._online = False
+                    self._last_probe = time.time()
+                    raise DiskNotFound(
+                        f"{self._endpoint} unreachable"
+                    ) from None
+        self._online = True
+        if resp.status == 200:
+            return payload
+        if resp.status in (400, 401):
+            try:
+                env = wire.unpack(payload)
+                raise wire.decode_error(env["error"], env["message"])
+            except (ValueError, KeyError, TypeError):
+                raise DiskNotFound(
+                    f"{self._endpoint}: bad error envelope"
+                ) from None
+        raise DiskNotFound(f"{self._endpoint}: HTTP {resp.status}")
+
+    def _should_probe(self) -> bool:
+        if time.time() - self._last_probe >= _RECONNECT_S:
+            self._online = True  # optimistic; next _call settles it
+            return True
+        return False
+
+    # ---- identity / health ----------------------------------------------
+
+    def is_online(self) -> bool:
+        if self._online:
+            return True
+        if not self._should_probe():
+            return False
+        try:
+            self._call("diskinfo")
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def endpoint(self) -> str:
+        return self._endpoint
+
+    def is_local(self) -> bool:
+        return False
+
+    def disk_info(self) -> DiskInfo:
+        d = wire.unpack(self._call("diskinfo"))
+        return DiskInfo(**d)
+
+    def get_disk_id(self) -> str:
+        return wire.unpack(self._call("getdiskid"))
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._disk_id = disk_id
+        self._call("setdiskid", body=wire.pack(disk_id))
+
+    def close(self) -> None:
+        self._drop_conn()
+
+    # ---- volumes --------------------------------------------------------
+
+    def make_vol(self, volume: str) -> None:
+        self._call("makevol", {"vol": volume})
+
+    def list_vols(self) -> list[VolInfo]:
+        return [
+            VolInfo(n, c)
+            for n, c in wire.unpack(self._call("listvols"))
+        ]
+
+    def stat_vol(self, volume: str) -> VolInfo:
+        n, c = wire.unpack(self._call("statvol", {"vol": volume}))
+        return VolInfo(n, c)
+
+    def delete_vol(self, volume: str, force: bool = False) -> None:
+        self._call(
+            "deletevol", {"vol": volume, "force": "1" if force else "0"}
+        )
+
+    # ---- raw files ------------------------------------------------------
+
+    def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:
+        return wire.unpack(
+            self._call(
+                "listdir",
+                {"vol": volume, "path": dir_path, "count": str(count)},
+            )
+        )
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        return self._call("readall", {"vol": volume, "path": path})
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self._call("writeall", {"vol": volume, "path": path}, data)
+
+    def delete_file(self, volume: str, path: str, recursive: bool = False) -> None:
+        self._call(
+            "deletefile",
+            {
+                "vol": volume,
+                "path": path,
+                "recursive": "1" if recursive else "0",
+            },
+        )
+
+    def rename_file(
+        self, src_volume: str, src_path: str, dst_volume: str, dst_path: str
+    ) -> None:
+        self._call(
+            "renamefile",
+            {
+                "vol": src_volume,
+                "path": src_path,
+                "dstvol": dst_volume,
+                "dstpath": dst_path,
+            },
+        )
+
+    def stat_file(self, volume: str, path: str) -> StatInfo:
+        size, mt, is_dir = wire.unpack(
+            self._call("statfile", {"vol": volume, "path": path})
+        )
+        return StatInfo(size, mt, is_dir)
+
+    # ---- shard streams --------------------------------------------------
+
+    def create_file(self, volume: str, path: str) -> ShardWriter:
+        return RemoteShardWriter(self, volume, path)
+
+    def read_file_stream(self, volume: str, path: str) -> ShardReader:
+        return RemoteShardReader(self, volume, path)
+
+    # ---- object metadata ------------------------------------------------
+
+    def read_version(
+        self, volume: str, path: str, version_id: str = ""
+    ) -> FileInfo:
+        raw = self._call(
+            "readversion",
+            {"vol": volume, "path": path, "versionid": version_id},
+        )
+        return wire.fileinfo_from_wire(wire.unpack(raw))
+
+    def read_xl(self, volume: str, path: str) -> XLMeta:
+        raw = self._call("readxl", {"vol": volume, "path": path})
+        xl = XLMeta()
+        for d in wire.unpack(raw):
+            xl.versions.append(wire.fileinfo_from_wire(d))
+        return xl
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call(
+            "writemetadata",
+            {"vol": volume, "path": path},
+            wire.pack(wire.fileinfo_to_wire(fi)),
+        )
+
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call(
+            "updatemetadata",
+            {"vol": volume, "path": path},
+            wire.pack(wire.fileinfo_to_wire(fi)),
+        )
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call(
+            "deleteversion",
+            {"vol": volume, "path": path},
+            wire.pack(wire.fileinfo_to_wire(fi)),
+        )
+
+    def rename_data(
+        self,
+        src_volume: str,
+        src_path: str,
+        fi: FileInfo,
+        dst_volume: str,
+        dst_path: str,
+    ) -> None:
+        self._call(
+            "renamedata",
+            {
+                "vol": src_volume,
+                "path": src_path,
+                "dstvol": dst_volume,
+                "dstpath": dst_path,
+            },
+            wire.pack(wire.fileinfo_to_wire(fi)),
+        )
+
+    # ---- maintenance ----------------------------------------------------
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        self._call(
+            "verifyfile",
+            {"vol": volume, "path": path},
+            wire.pack(wire.fileinfo_to_wire(fi)),
+        )
+
+    def walk(self, volume: str, prefix: str = ""):
+        yield from wire.unpack(
+            self._call("walk", {"vol": volume, "path": prefix})
+        )

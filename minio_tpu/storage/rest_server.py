@@ -106,6 +106,11 @@ class StorageRESTServer:
         if m == "statfile":
             st = disk.stat_file(vol, path)
             return wire.pack([st.size, st.mod_time_ns, st.is_dir])
+        if m == "appendfile":
+            disk.append_file(
+                vol, path, body, truncate=q.get("truncate") == "1"
+            )
+            return b""
         if m == "createfile":
             # whole shard body in one request (streamed chunked client-side)
             w = disk.create_file(vol, path)

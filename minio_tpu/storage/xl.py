@@ -373,6 +373,17 @@ class XLStorage(StorageAPI):
             finally:
                 rd.close()
 
+    def append_file(
+        self, volume: str, path: str, data: bytes, truncate: bool = False
+    ) -> None:
+        self._require_vol(volume)
+        fp = self._file_path(volume, path)
+        os.makedirs(os.path.dirname(fp), exist_ok=True)
+        with open(fp, "wb" if truncate else "ab") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
     def walk(self, volume: str, prefix: str = ""):
         """Yield object paths (dirs containing xl.meta) under prefix."""
         vol = self._require_vol(volume)
