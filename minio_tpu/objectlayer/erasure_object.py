@@ -560,10 +560,57 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     # heal (erasure-healing.go:227 healObject)
     # ------------------------------------------------------------------
 
+    def heal_bucket(self, bucket: str, dry_run: bool = False) -> dict:
+        """Recreate the bucket volume on online disks missing it
+        (erasure-healing.go:105 healBucket): a replaced/wiped drive loses
+        every volume, and object heal cannot rename into a volume that
+        does not exist.  Quorum of present copies is required before we
+        re-stamp the stragglers."""
+        check_bucket_name(bucket)
+        with self.nslock.write(bucket, ""):
+            disks = self._online_disks()  # one snapshot for probe + repair
+            present, missing = [], []
+            for i, d in enumerate(disks):
+                if d is None:
+                    continue
+                try:
+                    d.stat_vol(bucket)
+                    present.append(i)
+                except serrors.VolumeNotFound:
+                    missing.append(i)
+                except Exception:  # noqa: BLE001
+                    continue  # transient error: neither present nor missing
+            if not present:
+                raise BucketNotFound(bucket)
+            result = {
+                "bucket": bucket,
+                "present": present,
+                "healed": [],
+                "dry_run": dry_run,
+            }
+            if len(present) < self.read_quorum:
+                # bucket exists but too few confirmations to re-stamp
+                # stragglers safely; report without mutating
+                return result
+            if dry_run:
+                result["healed"] = missing
+                return result
+            for i in missing:
+                try:
+                    disks[i].make_vol(bucket)
+                    result["healed"].append(i)
+                except serrors.VolumeExists:
+                    result["healed"].append(i)
+                except Exception:  # noqa: BLE001
+                    pass
+            return result
+
     def heal_object(
         self, bucket, object_name, version_id="", dry_run=False
     ) -> dict:
-        self._require_bucket(bucket)
+        # heal the bucket volume first (MakeVol on wiped disks) so the
+        # shard rename below has a destination (erasure-healing.go:105)
+        self.heal_bucket(bucket, dry_run=dry_run)
         with self.nslock.write(bucket, object_name):
             disks_raw = self._online_disks()
             fis, errs = read_all_fileinfo(
@@ -605,6 +652,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             if not outdated or dry_run:
                 return result
             tmp_ids = {i: uuid.uuid4().hex for i in outdated}
+            # a fully wiped disk lost its staging volume too
+            for i in outdated:
+                try:
+                    disks[i].make_vol(SYS_VOL)
+                except Exception:  # noqa: BLE001
+                    pass
             for part in fi.parts:
                 readers = []
                 for i, d in enumerate(disks):

@@ -141,6 +141,22 @@ class ErasureSets(ObjectLayer):
             bucket, object_name, version_id, dry_run
         )
 
+    def heal_bucket(self, bucket, dry_run=False):
+        """Tolerant fan-out: one bad set must not block healing the
+        rest (erasure-healing.go healBucket sweeps every set)."""
+        healed = []
+        found = False
+        for si, s in enumerate(self.sets):
+            try:
+                r = s.heal_bucket(bucket, dry_run)
+                found = True
+                healed.extend((si, i) for i in r["healed"])
+            except api.BucketNotFound:
+                continue
+        if not found:
+            raise api.BucketNotFound(bucket)
+        return {"bucket": bucket, "healed": healed, "dry_run": dry_run}
+
     # -- listing (merge across sets) --------------------------------------
 
     def list_objects(self, bucket, prefix="", marker="", delimiter="",

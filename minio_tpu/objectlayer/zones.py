@@ -153,6 +153,20 @@ class ErasureZones(ObjectLayer):
         z = self._find_zone(bucket, object_name, version_id)
         return z.heal_object(bucket, object_name, version_id, dry_run)
 
+    def heal_bucket(self, bucket, dry_run=False):
+        healed = []
+        found = False
+        for zi, z in enumerate(self.zones):
+            try:
+                r = z.heal_bucket(bucket, dry_run)
+                found = True
+                healed.extend((zi, *t) for t in r["healed"])
+            except api.BucketNotFound:
+                continue
+        if not found:
+            raise api.BucketNotFound(bucket)
+        return {"bucket": bucket, "healed": healed, "dry_run": dry_run}
+
     # -- listing ----------------------------------------------------------
 
     def list_objects(self, bucket, prefix="", marker="", delimiter="",

@@ -21,6 +21,24 @@ import signal
 import sys
 
 
+def group_zone_args(zone_args: list[str]) -> list[list[str]]:
+    """Group CLI drive args into zones (createServerEndpoints,
+    endpoint-ellipses.go:331): args WITHOUT ellipses all join one zone
+    (verify-healing.sh lists endpoints individually); each arg WITH an
+    ellipses pattern is its own zone (server-pool syntax).  Mixing the
+    two styles is rejected, like the reference."""
+    from ..utils import ellipses
+
+    with_e = [a for a in zone_args if ellipses.has_ellipses(a)]
+    if not with_e:
+        return [list(zone_args)]
+    if len(with_e) != len(zone_args):
+        raise SystemExit(
+            "all drive args must use ellipses patterns, or none"
+        )
+    return [ellipses.expand(a) for a in zone_args]
+
+
 def build_object_layer(zone_args: list[str], parity: "int | None" = None):
     """Single-node convenience: expand bare-path args -> zones layer."""
     ol, _ = build_cluster(zone_args, local_port=0, secret="", parity=parity)
@@ -50,12 +68,11 @@ def build_cluster(
 
     zones = []
     local_disks: list = []
-    for zarg in zone_args:
-        specs = ellipses.expand(zarg)
+    for specs in group_zone_args(zone_args):
         eps = resolve_endpoints(specs, local_port)
         if len(eps) < 2:
             raise SystemExit(
-                f"zone {zarg!r} expands to {len(eps)} drives; need >= 2"
+                f"zone {specs!r} expands to {len(eps)} drives; need >= 2"
             )
         set_count, drives_per_set = ellipses.layout(len(eps))
         disks = []
@@ -131,8 +148,8 @@ def main(argv=None) -> int:
     # server-main.go:477, then waits for disks).
     pre_local: list = []
     local_map: dict = {}
-    for zarg in args.zones:
-        for ep in resolve_endpoints(ellipses.expand(zarg), local_port):
+    for specs in group_zone_args(args.zones):
+        for ep in resolve_endpoints(specs, local_port):
             if ep.is_local:
                 d = XLStorage(ep.path, endpoint=ep.raw)
                 pre_local.append(d)

@@ -300,7 +300,22 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._route_internode(
                     handler, path[len(prefix) + 1 :], query
                 )
+        # health endpoints are unauthenticated (healthcheck-handler.go:26-66)
+        if path == "/minio/health/live":
+            self._finish_body()  # keep-alive hygiene on early return
+            return self._respond(200, content_type="text/plain")
+        if path in ("/minio/health/ready", "/minio/health/cluster"):
+            self._finish_body()
+            if self.s3.object_layer is None:
+                return self._respond(503, content_type="text/plain")
+            return self._respond(200, content_type="text/plain")
         try:
+            # safe mode: every S3 request is 503 until the object layer
+            # attaches, even unauthenticated ones (server-main.go safe
+            # mode; advisor finding r2 — this must precede the anonymous
+            # AccessDenied so bootstrap is observable from outside)
+            if self.s3.object_layer is None:
+                raise S3Error("ServerNotInitialized")
             # body-framing validity precedes auth, matching the generic
             # middleware order (requestValidityHandler, routers.go:41-79)
             self._body_size()

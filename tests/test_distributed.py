@@ -224,6 +224,52 @@ def test_mixed_layer_degraded_and_heal(mixed_layer, tmp_path):
     assert out.getvalue() == data
 
 
+def test_local_volume_wipe_and_heal(tmp_path):
+    """Wipe a bucket volume on a *local* disk (drive swap); heal_object
+    must recreate the volume (heal_bucket / MakeVol semantics,
+    erasure-healing.go:105) before rebuilding shards."""
+    import shutil
+
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    layer.make_bucket("wbk")
+    data = _pay(2 * BLOCK + 7, seed=5)
+    layer.put_object("wbk", "obj", io.BytesIO(data), len(data))
+
+    victim = disks[2]
+    shutil.rmtree(os.path.join(victim.root, "wbk"))
+
+    healed = layer.heal_object("wbk", "obj")
+    assert healed["healed"]
+    assert "obj" in list(victim.walk("wbk"))
+    out = io.BytesIO()
+    layer.get_object("wbk", "obj", out)
+    assert out.getvalue() == data
+
+
+def test_full_disk_wipe_and_heal(tmp_path):
+    """Wipe an entire local disk (bucket volume AND .sys staging area);
+    heal must restore both."""
+    import shutil
+
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    layer.make_bucket("fbk")
+    data = _pay(BLOCK + 31, seed=6)
+    layer.put_object("fbk", "obj", io.BytesIO(data), len(data))
+
+    victim = disks[1]
+    for entry in os.listdir(victim.root):
+        shutil.rmtree(os.path.join(victim.root, entry))
+
+    healed = layer.heal_object("fbk", "obj")
+    assert healed["healed"]
+    assert "obj" in list(victim.walk("fbk"))
+    out = io.BytesIO()
+    layer.get_object("fbk", "obj", out)
+    assert out.getvalue() == data
+
+
 # -- multi-process cluster -------------------------------------------------
 
 
@@ -248,10 +294,14 @@ def test_two_node_cluster(tmp_path):
     for d in (n1, n2):
         for i in (1, 2):
             (d / f"d{i}").mkdir(parents=True)
-    endpoints = (
-        f"http://127.0.0.1:{p1}{n1}/d{{1...2}} "
-        f"http://127.0.0.1:{p2}{n2}/d{{1...2}}"
-    ).split()
+    # verify-healing.sh style: endpoints listed individually (no
+    # ellipses) form ONE zone / one 4-drive set spanning both nodes
+    endpoints = [
+        f"http://127.0.0.1:{p1}{n1}/d1",
+        f"http://127.0.0.1:{p1}{n1}/d2",
+        f"http://127.0.0.1:{p2}{n2}/d1",
+        f"http://127.0.0.1:{p2}{n2}/d2",
+    ]
 
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -289,14 +339,14 @@ def test_two_node_cluster(tmp_path):
                         )
                 try:
                     req = urllib.request.Request(
-                        f"http://127.0.0.1:{port}/", method="GET"
+                        f"http://127.0.0.1:{port}/minio/health/ready",
+                        method="GET",
                     )
                     with urllib.request.urlopen(req, timeout=2) as r:
-                        if r.status != 503:
+                        if r.status == 200:
                             return
-                except urllib.error.HTTPError as e:
-                    if e.code != 503:
-                        return  # 403 AccessDenied = initialized
+                except urllib.error.HTTPError:
+                    pass
                 except OSError:
                     pass
                 time.sleep(0.5)
