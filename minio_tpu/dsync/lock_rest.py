@@ -1,0 +1,200 @@
+"""Lock REST plane: the NetLocker service each node exposes to peers
+(cmd/lock-rest-server.go:87, lock-rest-client.go).
+
+Mounted on the node's single internode listener under
+/minio-tpu/lock/v1/<method> next to the storage plane (routers.go:25-38):
+POST bodies are msgpack {uid, resources, source}, responses are msgpack
+booleans, and every request carries the internode JWT.  Connection
+failures surface as False grants on lock/rlock (the requesting DRWMutex
+counts them against tolerance) and are swallowed on release/refresh
+(the entry ages out server-side).
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+import urllib.parse
+
+import msgpack
+
+from ..utils import jwt
+from .drwmutex import LockArgs, NetLocker
+from .local_locker import LocalLocker
+
+PREFIX = "/minio-tpu/lock/v1"
+_TOKEN_TTL_S = 900
+
+_METHODS = ("lock", "unlock", "rlock", "runlock", "refresh", "forceunlock")
+
+
+def _pack_args(args: LockArgs) -> bytes:
+    return msgpack.packb(
+        {
+            "uid": args.uid,
+            "resources": list(args.resources),
+            "source": args.source,
+        },
+        use_bin_type=True,
+    )
+
+
+def _unpack_args(body: bytes) -> LockArgs:
+    d = msgpack.unpackb(body, raw=False)
+    return LockArgs(
+        uid=d["uid"],
+        resources=tuple(d["resources"]),
+        source=d.get("source", ""),
+    )
+
+
+class LockRESTServer:
+    """Dispatches lock-plane requests onto this node's LocalLocker."""
+
+    def __init__(self, locker: LocalLocker, secret: str):
+        self.locker = locker
+        self._secret = secret
+
+    def handle(
+        self,
+        method_name: str,
+        query: dict,
+        body: bytes,
+        headers: "dict | None" = None,
+    ) -> tuple[int, bytes, dict]:
+        try:
+            authz = {
+                k.lower(): v for k, v in (headers or {}).items()
+            }.get("authorization", "")
+            if not authz.startswith("Bearer "):
+                raise jwt.JWTError("missing bearer token")
+            jwt.verify(authz[len("Bearer ") :], self._secret)
+        except Exception as e:  # noqa: BLE001
+            return 401, msgpack.packb(str(e)), {}
+        if method_name not in _METHODS:
+            return 400, msgpack.packb(f"unknown method {method_name}"), {}
+        try:
+            args = _unpack_args(body)
+            fn = {
+                "lock": self.locker.lock,
+                "unlock": self.locker.unlock,
+                "rlock": self.locker.rlock,
+                "runlock": self.locker.runlock,
+                "refresh": self.locker.refresh,
+                "forceunlock": self.locker.force_unlock,
+            }[method_name]
+            return 200, msgpack.packb(bool(fn(args))), {}
+        except Exception as e:  # noqa: BLE001
+            return 400, msgpack.packb(str(e)), {}
+
+
+class LockRESTClient(NetLocker):
+    """NetLocker for a peer node's lock plane."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        secret: str,
+        timeout: float = 5.0,
+    ):
+        self.host = host
+        self.port = port
+        self._secret = secret
+        self._timeout = timeout
+        self._local = threading.local()
+        self._token = ""
+        self._token_exp = 0.0
+
+    def _bearer(self) -> str:
+        now = time.time()
+        if now > self._token_exp - 60:
+            self._token = jwt.sign(
+                {"sub": "minio-tpu-lock"}, self._secret, _TOKEN_TTL_S
+            )
+            self._token_exp = now + _TOKEN_TTL_S
+        return self._token
+
+    def _conn(self) -> http.client.HTTPConnection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(
+                self.host, self.port, timeout=self._timeout
+            )
+            self._local.conn = c
+        return c
+
+    def _drop_conn(self) -> None:
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._local.conn = None
+
+    def _call(self, method: str, args: LockArgs) -> bool:
+        body = _pack_args(args)
+        headers = {
+            "Authorization": f"Bearer {self._bearer()}",
+            "Content-Length": str(len(body)),
+        }
+        url = f"{PREFIX}/{method}"
+        # lock/rlock are NOT retried: a lost response may mean the grant
+        # was applied server-side, and re-sending the same uid would turn
+        # it into an unowned phantom grant.  The caller cleans up with a
+        # best-effort release instead (DRWMutex.ask).  Releases and
+        # refreshes are idempotent and retry once on a fresh connection.
+        attempts = (0,) if method in ("lock", "rlock") else (0, 1)
+        for attempt in attempts:
+            conn = self._conn()
+            try:
+                conn.request("POST", url, body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                break
+            except (OSError, http.client.HTTPException):
+                self._drop_conn()
+                if attempt == attempts[-1]:
+                    raise ConnectionError(
+                        f"lock plane {self.host}:{self.port} unreachable"
+                    ) from None
+        if resp.status != 200:
+            raise ConnectionError(
+                f"lock plane {self.host}:{self.port}: "
+                f"HTTP {resp.status} {msgpack.unpackb(payload, raw=False)!r}"
+            )
+        return bool(msgpack.unpackb(payload, raw=False))
+
+    # -- NetLocker --------------------------------------------------------
+
+    def lock(self, args: LockArgs) -> bool:
+        return self._call("lock", args)
+
+    def unlock(self, args: LockArgs) -> bool:
+        return self._call("unlock", args)
+
+    def rlock(self, args: LockArgs) -> bool:
+        return self._call("rlock", args)
+
+    def runlock(self, args: LockArgs) -> bool:
+        return self._call("runlock", args)
+
+    def refresh(self, args: LockArgs) -> bool:
+        return self._call("refresh", args)
+
+    def force_unlock(self, args: LockArgs) -> bool:
+        return self._call("forceunlock", args)
+
+    def is_online(self) -> bool:
+        try:
+            self._call(
+                "refresh", LockArgs(uid="probe", resources=("probe",))
+            )
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def close(self) -> None:
+        self._drop_conn()

@@ -125,3 +125,40 @@ class NamespaceLock:
                 lk.release_write()
         finally:
             self._put(key)
+
+
+class DistNamespaceLock:
+    """NamespaceLock backed by dsync quorum locks (distLockInstance,
+    namespace-lock.go:140): selected when the cluster spans more than
+    one node, so concurrent object ops from different processes
+    serialize through the lock plane."""
+
+    def __init__(self, ds, source: str = ""):
+        from .drwmutex import DRWMutex, Dsync  # noqa: F401 (typing aid)
+
+        self._ds = ds
+        self._source = source
+
+    @contextlib.contextmanager
+    def read(self, volume: str, path: str, timeout: "float | None" = 30.0):
+        from .drwmutex import DRWMutex
+
+        m = DRWMutex(self._ds, f"{volume}/{path}")
+        if not m.get_rlock(self._source, timeout):
+            raise LockTimeout(f"{volume}/{path}")
+        try:
+            yield
+        finally:
+            m.runlock()
+
+    @contextlib.contextmanager
+    def write(self, volume: str, path: str, timeout: "float | None" = 30.0):
+        from .drwmutex import DRWMutex
+
+        m = DRWMutex(self._ds, f"{volume}/{path}")
+        if not m.get_lock(self._source, timeout):
+            raise LockTimeout(f"{volume}/{path}")
+        try:
+            yield
+        finally:
+            m.unlock()

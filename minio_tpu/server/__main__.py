@@ -52,6 +52,7 @@ def build_cluster(
     parity: "int | None" = None,
     format_timeout_s: float = 120.0,
     local_disk_map: "dict | None" = None,
+    nslock=None,
 ):
     """Expand args -> local XLStorage + remote REST disks -> zones layer.
 
@@ -98,10 +99,74 @@ def build_cluster(
         )
         zones.append(
             ErasureSets(
-                ordered, set_count, drives_per_set, parity_blocks=parity
+                ordered,
+                set_count,
+                drives_per_set,
+                parity_blocks=parity,
+                nslock=nslock,
             )
         )
     return ErasureZones(zones), local_disks
+
+
+def cluster_nodes(zone_args: list[str], local_port: int):
+    """Sorted unique (host, port, is_local) across every URL endpoint -
+    the lock-plane topology (one locker per node, like newLockAPI per
+    endpoint host)."""
+    from ..cluster.endpoints import resolve_endpoints
+
+    nodes: dict = {}
+    for specs in group_zone_args(zone_args):
+        for ep in resolve_endpoints(specs, local_port):
+            if ep.is_url:
+                nodes[(ep.host, ep.port)] = (
+                    nodes.get((ep.host, ep.port), False) or ep.is_local
+                )
+    return [
+        (h, p, nodes[(h, p)]) for h, p in sorted(nodes)
+    ]
+
+
+def build_lock_plane(
+    zone_args: list[str], local_port: int, secret: str
+):
+    """(nslock, lock_rest_server, maintenance) for this topology.
+
+    Single-node (or bare-path) layouts use the in-process NamespaceLock;
+    multi-node layouts get dsync quorum locks over the lock REST plane
+    with refresh + expiry recovery (see dsync/drwmutex.py).
+    """
+    from ..dsync import drwmutex
+    from ..dsync.local_locker import LocalLocker, LockMaintenance
+    from ..dsync.lock_rest import LockRESTClient, LockRESTServer
+    from ..dsync.namespace import DistNamespaceLock, NamespaceLock
+
+    nodes = cluster_nodes(zone_args, local_port)
+    if len(nodes) <= 1:
+        return NamespaceLock(), None, None
+    refresh_s = float(
+        os.environ.get("MINIO_TPU_LOCK_REFRESH_S")
+        or drwmutex.REFRESH_INTERVAL_S
+    )
+    expiry_s = float(
+        os.environ.get("MINIO_TPU_LOCK_EXPIRY_S") or drwmutex.EXPIRY_S
+    )
+    local = LocalLocker(endpoint=f"local:{local_port}")
+    lockers = [
+        local
+        if is_local
+        else LockRESTClient(host, port, secret)
+        for host, port, is_local in nodes
+    ]
+    ds = drwmutex.Dsync(lockers, refresh_interval_s=refresh_s)
+    maint = LockMaintenance(
+        local, interval_s=max(1.0, expiry_s / 3), expiry_s=expiry_s
+    ).start()
+    return (
+        DistNamespaceLock(ds),
+        LockRESTServer(local, secret),
+        maint,
+    )
 
 
 def main(argv=None) -> int:
@@ -164,6 +229,13 @@ def main(argv=None) -> int:
     )
     storage_rest = StorageRESTServer(pre_local, args.secret_key)
     srv.register_internode(STORAGE_PREFIX, storage_rest.handle)
+    nslock, lock_rest, _lock_maint = build_lock_plane(
+        args.zones, local_port, args.secret_key
+    )
+    if lock_rest is not None:
+        from ..dsync.lock_rest import PREFIX as LOCK_PREFIX
+
+        srv.register_internode(LOCK_PREFIX, lock_rest.handle)
     srv.start()
     print(f"minio-tpu listening at {srv.endpoint} (bootstrapping)")
 
@@ -174,6 +246,7 @@ def main(argv=None) -> int:
         args.parity,
         format_timeout_s=args.format_timeout,
         local_disk_map=local_map,
+        nslock=nslock,
     )
     srv.object_layer = ol
     si = ol.storage_info()

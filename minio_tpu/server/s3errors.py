@@ -59,6 +59,7 @@ _E = {
     "SignatureDoesNotMatch": ("The request signature we calculated does not match the signature you provided.", H.FORBIDDEN),
     "SignatureVersionNotSupported": ("The authorization mechanism you have provided is not supported.", H.BAD_REQUEST),
     "ServerNotInitialized": ("Server not initialized, please try again.", H.SERVICE_UNAVAILABLE),
+    "OperationTimedOut": ("A timeout occurred while trying to lock a resource, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "SlowDown": ("Resource requested is unreadable, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "XAmzContentSHA256Mismatch": ("The provided 'x-amz-content-sha256' header does not match what was computed.", H.BAD_REQUEST),
     "MalformedPOSTRequest": ("The body of your POST request is not well-formed multipart/form-data.", H.BAD_REQUEST),
@@ -77,6 +78,12 @@ class S3Error(Exception):
     def __init__(self, code: str, message: str = ""):
         self.err = get(code, message)
         super().__init__(self.err.message)
+
+
+def _lock_timeout():
+    from ..dsync.namespace import LockTimeout
+
+    return LockTimeout
 
 
 def from_exception(e: Exception) -> APIError:
@@ -101,6 +108,9 @@ def from_exception(e: Exception) -> APIError:
         (olapi.PreconditionFailed, "PreconditionFailed"),
         (olapi.ReadQuorumError, "SlowDown"),
         (olapi.WriteQuorumError, "SlowDown"),
+        # lock quorum unavailable (dead peers) = service unavailable,
+        # matching the reference's OperationTimedOut 503
+        (_lock_timeout(), "OperationTimedOut"),
         (BadDigest, "BadDigest"),
         (SizeMismatch, "IncompleteBody"),
         (serrors.FileNotFound, "NoSuchKey"),

@@ -283,6 +283,112 @@ def _free_port():
     return port
 
 
+def _spawn_cluster(tmp_path, ports, extra_env=None):
+    """Start one server process per port over a shared 4-drive layout;
+    returns (procs, endpoints)."""
+    dirs = [tmp_path / f"n{i+1}" for i in range(len(ports))]
+    for d in dirs:
+        for i in (1, 2):
+            (d / f"d{i}").mkdir(parents=True)
+    endpoints = [
+        f"http://127.0.0.1:{port}{d}/d{i}"
+        for port, d in zip(ports, dirs)
+        for i in (1, 2)
+    ]
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    env.update(extra_env or {})
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "minio_tpu.server",
+                "--address", f"127.0.0.1:{port}",
+                "--format-timeout", "60",
+                *endpoints,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for port in ports
+    ]
+    return procs, endpoints
+
+
+def _wait_ready(procs, port, timeout=90):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for pr in procs:
+            if pr.poll() is not None:
+                out = pr.stdout.read().decode(errors="replace")
+                raise AssertionError(
+                    f"server died rc={pr.returncode}:\n{out}"
+                )
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/minio/health/ready",
+                method="GET",
+            )
+            with urllib.request.urlopen(req, timeout=2) as r:
+                if r.status == 200:
+                    return
+        except (urllib.error.HTTPError, OSError):
+            pass
+        time.sleep(0.5)
+    raise AssertionError(f"node :{port} never became ready")
+
+
+@pytest.mark.slow
+def test_cross_node_put_race_serializes(tmp_path):
+    """Two processes race PUTs to ONE object; dsync quorum locks must
+    serialize them so every GET returns one writer's payload intact
+    (never an interleaving or a quorum-broken object)."""
+    ports = [_free_port(), _free_port()]
+    procs, _ = _spawn_cluster(tmp_path, ports)
+    try:
+        for port in ports:
+            _wait_ready(procs, port)
+        c1 = S3Client(f"http://127.0.0.1:{ports[0]}")
+        c2 = S3Client(f"http://127.0.0.1:{ports[1]}")
+        assert c1.make_bucket("race").status == 200
+
+        pay_a = _pay(150_000, seed=10)
+        pay_b = _pay(150_000, seed=11)
+        for _ in range(4):
+            results = {}
+
+            def put(client, body, tag):
+                results[tag] = client.put_object("race", "obj", body)
+
+            ta = _thread(put, c1, pay_a, "a")
+            tb = _thread(put, c2, pay_b, "b")
+            ta.join(timeout=60)
+            tb.join(timeout=60)
+            assert results["a"].status == 200
+            assert results["b"].status == 200
+            r = c1.get_object("race", "obj")
+            assert r.status == 200
+            assert r.body in (pay_a, pay_b), "interleaved write!"
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+                pr.wait(timeout=10)
+
+
+def _thread(fn, *args):
+    import threading
+
+    t = threading.Thread(target=fn, args=args)
+    t.start()
+    return t
+
+
 @pytest.mark.slow
 def test_two_node_cluster(tmp_path):
     """verify-healing.sh style: 2 real server processes, one endpoint

@@ -1,0 +1,381 @@
+"""dsync quorum-lock tests (pkg/dsync drwmutex_test.go scenarios +
+lock-rest plane + stale-lock recovery).
+"""
+
+import threading
+import time
+
+import pytest
+
+from minio_tpu.dsync.drwmutex import (
+    DRWMutex,
+    Dsync,
+    LockArgs,
+    _quorums,
+)
+from minio_tpu.dsync.local_locker import LocalLocker, LockMaintenance
+from minio_tpu.dsync.lock_rest import (
+    PREFIX as LOCK_PREFIX,
+    LockRESTClient,
+    LockRESTServer,
+)
+from minio_tpu.dsync.namespace import DistNamespaceLock, LockTimeout
+from minio_tpu.server.http import S3Server
+
+SECRET = "minioadmin"
+
+
+def args(uid, *resources):
+    return LockArgs(uid=uid, resources=resources)
+
+
+# -- LocalLocker unit semantics (local-locker.go) --------------------------
+
+
+def test_local_locker_write_excludes():
+    lk = LocalLocker()
+    assert lk.lock(args("u1", "b/o"))
+    assert not lk.lock(args("u2", "b/o"))
+    assert not lk.rlock(args("u3", "b/o"))
+    assert lk.unlock(args("u1", "b/o"))
+    assert lk.lock(args("u2", "b/o"))
+
+
+def test_local_locker_readers_stack():
+    lk = LocalLocker()
+    assert lk.rlock(args("r1", "b/o"))
+    assert lk.rlock(args("r2", "b/o"))
+    assert not lk.lock(args("w1", "b/o"))
+    assert lk.runlock(args("r1", "b/o"))
+    assert not lk.lock(args("w1", "b/o"))  # one reader left
+    assert lk.runlock(args("r2", "b/o"))
+    assert lk.lock(args("w1", "b/o"))
+
+
+def test_local_locker_unlock_validation():
+    lk = LocalLocker()
+    assert not lk.unlock(args("nope", "b/o"))  # nothing held
+    lk.rlock(args("r1", "b/o"))
+    assert not lk.unlock(args("r1", "b/o"))  # write-unlock of read lock
+    assert lk.runlock(args("r1", "b/o"))
+
+
+def test_local_locker_multi_resource_all_or_nothing():
+    lk = LocalLocker()
+    lk.lock(args("u1", "b/a"))
+    # u2 wants a+b: must fail entirely, leaving b untouched
+    assert not lk.lock(args("u2", "b/a", "b/b"))
+    assert lk.lock(args("u3", "b/b"))
+
+
+def test_local_locker_expiry():
+    lk = LocalLocker()
+    lk.lock(args("dead", "b/o"))
+    time.sleep(0.05)
+    assert lk.expire_old(max_age_s=0.01) == 1
+    assert lk.lock(args("alive", "b/o"))
+    # refresh keeps an entry alive
+    lk.refresh(args("alive", "b/o"))
+    assert lk.expire_old(max_age_s=10.0) == 0
+
+
+# -- quorum math (drwmutex.go:184-199) -------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n,read,quorum",
+    [
+        (1, False, 1),
+        (2, False, 2),  # even: write needs n/2+1
+        (3, False, 2),
+        (4, False, 3),
+        (8, False, 5),
+        (2, True, 1),
+        (3, True, 2),
+        (4, True, 2),
+        (8, True, 4),
+    ],
+)
+def test_quorum_math(n, read, quorum):
+    q, tol = _quorums(n, read)
+    assert q == quorum
+    assert q + tol == n
+
+
+# -- DRWMutex over in-process lockers --------------------------------------
+
+
+def _dsync(n=3, refresh=60.0):
+    lockers = [LocalLocker(endpoint=f"n{i}") for i in range(n)]
+    return Dsync(lockers, refresh_interval_s=refresh), lockers
+
+
+def test_drwmutex_mutual_exclusion():
+    ds, _ = _dsync()
+    order = []
+
+    def worker(tag):
+        m = DRWMutex(ds, "bkt/obj")
+        assert m.get_lock(tag, timeout=10)
+        order.append(f"{tag}-in")
+        time.sleep(0.05)
+        order.append(f"{tag}-out")
+        m.unlock()
+
+    ts = [
+        threading.Thread(target=worker, args=(t,)) for t in ("a", "b")
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # strict alternation: in/out pairs never interleave
+    assert order in (
+        ["a-in", "a-out", "b-in", "b-out"],
+        ["b-in", "b-out", "a-in", "a-out"],
+    )
+    ds.close()
+
+
+def test_drwmutex_readers_shared_writer_excluded():
+    ds, _ = _dsync()
+    r1 = DRWMutex(ds, "bkt/obj")
+    r2 = DRWMutex(ds, "bkt/obj")
+    assert r1.get_rlock(timeout=2)
+    assert r2.get_rlock(timeout=2)
+    w = DRWMutex(ds, "bkt/obj")
+    assert not w.get_lock(timeout=0.3)
+    r1.runlock()
+    r2.runlock()
+    assert w.get_lock(timeout=2)
+    w.unlock()
+    ds.close()
+
+
+class _DeadLocker(LocalLocker):
+    def lock(self, a):  # noqa: D102
+        raise ConnectionError("down")
+
+    def rlock(self, a):  # noqa: D102
+        raise ConnectionError("down")
+
+
+def test_drwmutex_quorum_with_node_down():
+    # 3 lockers, one dead: write quorum 2 still reachable
+    lockers = [LocalLocker(), _DeadLocker(), LocalLocker()]
+    ds = Dsync(lockers, refresh_interval_s=60.0)
+    m = DRWMutex(ds, "bkt/obj")
+    assert m.get_lock(timeout=2)
+    m.unlock()
+    ds.close()
+
+
+def test_drwmutex_no_quorum_two_down():
+    lockers = [LocalLocker(), _DeadLocker(), _DeadLocker()]
+    ds = Dsync(lockers, refresh_interval_s=60.0)
+    m = DRWMutex(ds, "bkt/obj")
+    assert not m.get_lock(timeout=0.5)
+    # the one live locker must hold no residue (releaseAll semantics)
+    assert lockers[0].lock(args("fresh", "bkt/obj"))
+    ds.close()
+
+
+def test_drwmutex_failure_releases_partial_grants():
+    ds, lockers = _dsync()
+    held = DRWMutex(ds, "bkt/obj")
+    assert held.get_lock(timeout=2)
+    contender = DRWMutex(ds, "bkt/obj")
+    assert not contender.get_lock(timeout=0.3)
+    held.unlock()
+    # all lockers clean after the failed attempt + release
+    for lk in lockers:
+        assert lk.lock(args("probe", "bkt/obj"))
+        assert lk.unlock(args("probe", "bkt/obj"))
+    ds.close()
+
+
+def test_rlock_multi_resource_rejected():
+    ds, _ = _dsync()
+    m = DRWMutex(ds, "b/a", "b/b")
+    with pytest.raises(ValueError):
+        m.get_rlock(timeout=0.5)
+    assert m.get_lock(timeout=2)  # write locks span resources
+    m.unlock()
+    ds.close()
+
+
+class _RefusingRefresh(LocalLocker):
+    def refresh(self, a):  # noqa: D102
+        raise ConnectionError("down")
+
+
+def test_refresh_quorum_loss_marks_lock_lost():
+    # 3 lockers, 2 stop answering refreshes: holder must learn its
+    # exclusivity is gone (is_lost) instead of writing unprotected
+    lockers = [LocalLocker(), _RefusingRefresh(), _RefusingRefresh()]
+    ds = Dsync(lockers, refresh_interval_s=0.05)
+    m = DRWMutex(ds, "bkt/obj")
+    assert m.get_lock(timeout=2)
+    uid = m._uid
+    deadline = time.monotonic() + 3
+    while not ds.is_lost(uid) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert ds.is_lost(uid)
+    ds.close()
+
+
+# -- stale-lock recovery (holder dies, expiry frees) -----------------------
+
+
+def test_dead_holder_lock_expires():
+    ds_a, lockers = _dsync(refresh=0.05)
+    m = DRWMutex(ds_a, "bkt/obj")
+    assert m.get_lock(timeout=2)
+    # holder dies: refresher stops, lock never released
+    ds_a.close()
+    maints = [
+        LockMaintenance(lk, interval_s=0.05, expiry_s=0.2).start()
+        for lk in lockers
+    ]
+    try:
+        ds_b = Dsync(lockers, refresh_interval_s=0.05)
+        m2 = DRWMutex(ds_b, "bkt/obj")
+        assert m2.get_lock(timeout=5), "expiry must free the dead lock"
+        m2.unlock()
+        ds_b.close()
+    finally:
+        for mt in maints:
+            mt.stop()
+
+
+def test_live_holder_survives_maintenance():
+    ds, lockers = _dsync(refresh=0.05)
+    maints = [
+        LockMaintenance(lk, interval_s=0.05, expiry_s=0.3).start()
+        for lk in lockers
+    ]
+    try:
+        m = DRWMutex(ds, "bkt/obj")
+        assert m.get_lock(timeout=2)
+        time.sleep(0.8)  # several expiry windows; refresher keeps alive
+        contender = DRWMutex(ds, "bkt/obj")
+        assert not contender.get_lock(timeout=0.3)
+        m.unlock()
+    finally:
+        for mt in maints:
+            mt.stop()
+        ds.close()
+
+
+# -- lock REST plane -------------------------------------------------------
+
+
+@pytest.fixture()
+def lock_cluster():
+    """3 lock servers on localhost, clients for each (the
+    dsync-server_test.go layout)."""
+    servers, clients = [], []
+    for _ in range(3):
+        locker = LocalLocker()
+        srv = S3Server(None, address="127.0.0.1:0", secret_key=SECRET)
+        srv.register_internode(
+            LOCK_PREFIX, LockRESTServer(locker, SECRET).handle
+        )
+        srv.start()
+        servers.append((srv, locker))
+        clients.append(LockRESTClient("127.0.0.1", srv.port, SECRET))
+    yield servers, clients
+    for srv, _ in servers:
+        srv.shutdown()
+
+
+def test_lock_rest_roundtrip(lock_cluster):
+    servers, clients = lock_cluster
+    c = clients[0]
+    assert c.lock(args("u1", "b/o"))
+    assert not c.lock(args("u2", "b/o"))
+    assert c.refresh(args("u1", "b/o"))
+    assert c.unlock(args("u1", "b/o"))
+    assert c.rlock(args("r1", "b/o"))
+    assert c.rlock(args("r2", "b/o"))
+    assert c.runlock(args("r1", "b/o"))
+    assert c.runlock(args("r2", "b/o"))
+    assert c.force_unlock(args("", "b/o")) is False  # nothing held
+
+
+def test_lock_rest_rejects_bad_jwt(lock_cluster):
+    servers, _ = lock_cluster
+    bad = LockRESTClient(
+        "127.0.0.1", servers[0][0].port, "wrong-secret"
+    )
+    with pytest.raises(ConnectionError):
+        bad.lock(args("u1", "b/o"))
+
+
+def test_drwmutex_over_rest_plane(lock_cluster):
+    """Two DRWMutexes from 'different processes' (separate Dsync
+    instances) racing over the wire serialize."""
+    _, clients = lock_cluster
+    ds1 = Dsync(clients, refresh_interval_s=60.0)
+    # second client set simulating another process
+    ds2 = Dsync(
+        [
+            LockRESTClient(c.host, c.port, SECRET)
+            for c in clients
+        ],
+        refresh_interval_s=60.0,
+    )
+    m1 = DRWMutex(ds1, "bkt/obj")
+    m2 = DRWMutex(ds2, "bkt/obj")
+    assert m1.get_lock(timeout=2)
+    assert not m2.get_lock(timeout=0.3)
+    m1.unlock()
+    assert m2.get_lock(timeout=2)
+    m2.unlock()
+    ds1.close()
+    ds2.close()
+
+
+def test_dead_holder_over_rest_plane(lock_cluster):
+    """Kill the holder (stop refreshing); server-side maintenance frees
+    the lock for a second process."""
+    servers, clients = lock_cluster
+    ds_a = Dsync(clients, refresh_interval_s=0.05)
+    m = DRWMutex(ds_a, "bkt/obj")
+    assert m.get_lock(timeout=2)
+    ds_a.close()  # holder process dies
+    maints = [
+        LockMaintenance(locker, interval_s=0.05, expiry_s=0.2).start()
+        for _, locker in servers
+    ]
+    try:
+        ds_b = Dsync(
+            [LockRESTClient(c.host, c.port, SECRET) for c in clients],
+            refresh_interval_s=0.05,
+        )
+        m2 = DRWMutex(ds_b, "bkt/obj")
+        assert m2.get_lock(timeout=5)
+        m2.unlock()
+        ds_b.close()
+    finally:
+        for mt in maints:
+            mt.stop()
+
+
+# -- DistNamespaceLock -----------------------------------------------------
+
+
+def test_dist_namespace_lock_interface():
+    ds, _ = _dsync()
+    ns = DistNamespaceLock(ds)
+    with ns.write("bkt", "obj"):
+        with pytest.raises(LockTimeout):
+            with ns.write("bkt", "obj", timeout=0.2):
+                pass
+        with pytest.raises(LockTimeout):
+            with ns.read("bkt", "obj", timeout=0.2):
+                pass
+    with ns.read("bkt", "obj"):
+        with ns.read("bkt", "obj", timeout=1):
+            pass
+    ds.close()
