@@ -144,7 +144,6 @@ class Dsync:
 
     def _refresh_loop(self, locker_index: int) -> None:
         c = self.lockers[locker_index]
-        ok_counts: dict[str, int] = {}
         while not self._stop.wait(self._refresh_interval):
             with self._mu:
                 batch = [a for a, _ in self._held.values()]

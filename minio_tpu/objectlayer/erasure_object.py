@@ -83,6 +83,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         from ..dsync.namespace import NamespaceLock
 
         self.nslock = nslock or NamespaceLock()
+        # MRF seam (addPartial, erasure-object.go:999): called with
+        # (bucket, object) when a write misses disks or a read detects
+        # bitrot; wired to the background heal queue by the server
+        self.heal_hook = None
 
     # ------------------------------------------------------------------
     # quorums (erasure-object.go:593-596)
@@ -278,6 +282,15 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         except WriteQuorumError:
             self._cleanup_tmp(disks, tmp_ids)
             raise
+        # MRF: quorum met but some disks missed the write - queue the
+        # object for immediate background heal (addPartial)
+        if self.heal_hook is not None and any(
+            e is not None for e in errs
+        ):
+            try:
+                self.heal_hook(bucket, object_name)
+            except Exception:  # noqa: BLE001
+                pass
         # overwrite cleanup: drop the replaced data dir (best effort)
         if old_data_dir and old_data_dir != data_dir:
             for d in disks:
@@ -420,6 +433,13 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             info = self._to_object_info(bucket, object_name, fi)
             if heal_required:
                 info.user_defined["x-internal-heal-required"] = "true"
+                # bitrot / missing shard seen on the read path: queue a
+                # deep heal (deepHealObject, erasure-object.go:306-310)
+                if self.heal_hook is not None:
+                    try:
+                        self.heal_hook(bucket, object_name)
+                    except Exception:  # noqa: BLE001
+                        pass
             return info
 
     def _part_readers(

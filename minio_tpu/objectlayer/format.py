@@ -77,6 +77,10 @@ def read_format(disk) -> "FormatErasure | None":
 
 
 def write_format(disk, fmt: FormatErasure) -> None:
+    try:
+        disk.make_vol(".sys")  # a wiped drive lost its staging volume
+    except Exception:  # noqa: BLE001
+        pass
     disk.write_all(".sys", FORMAT_FILE, fmt.to_bytes())
     disk.set_disk_id(fmt.this)
 
@@ -227,5 +231,8 @@ def load_or_init_format(
             sets=ref.sets,
         )
         write_format(disk, fmt)
+        # flag for the fresh-disk monitor: this slot holds a replaced
+        # drive whose set must be swept (healErasureSet) after boot
+        disk._freshly_stamped = True
         ordered[idx] = disk
     return ref, ordered

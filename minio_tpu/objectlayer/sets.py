@@ -33,6 +33,7 @@ class ErasureSets(ObjectLayer):
         parity_blocks: "int | None" = None,
         block_size: "int | None" = None,
         nslock=None,
+        format_ref=None,
     ):
         if len(disks) != set_count * drives_per_set:
             raise ValueError("disk count != sets * drives")
@@ -41,6 +42,7 @@ class ErasureSets(ObjectLayer):
 
         self.set_count = set_count
         self.drives_per_set = drives_per_set
+        self.format_ref = format_ref  # FormatErasure (fresh-disk heal)
         nslock = nslock or NamespaceLock()
         self.sets: list[ErasureObjects] = [
             ErasureObjects(

@@ -90,7 +90,7 @@ def build_cluster(
                 )
         # only the owner of the first endpoint may mint a fresh cluster
         init_allowed = eps[0].is_local
-        _, ordered = wait_for_format(
+        ref_fmt, ordered = wait_for_format(
             disks,
             set_count,
             drives_per_set,
@@ -104,9 +104,37 @@ def build_cluster(
                 drives_per_set,
                 parity_blocks=parity,
                 nslock=nslock,
+                format_ref=ref_fmt,
             )
         )
     return ErasureZones(zones), local_disks
+
+
+def start_background_heal(ol):
+    """MRF queue + heal routine + fresh-disk monitor over the object
+    layer (startBackgroundOps analogue, server-main.go:524).  Returns
+    (routine, monitor); both are daemon threads."""
+    from ..heal.background import FreshDiskMonitor, HealQueue, HealRoutine
+
+    queue = HealQueue()
+    routine = HealRoutine(
+        ol,
+        queue,
+        throttle_s=float(
+            os.environ.get("MINIO_TPU_HEAL_THROTTLE_S") or 0.0
+        ),
+    ).start()
+    monitor = FreshDiskMonitor(
+        ol,
+        queue,
+        interval_s=float(
+            os.environ.get("MINIO_TPU_FRESH_DISK_INTERVAL_S") or 10.0
+        ),
+    ).start()
+    for zone in ol.zones:
+        for eset in zone.sets:
+            eset.heal_hook = queue.push_object
+    return routine, monitor
 
 
 def cluster_nodes(zone_args: list[str], local_port: int):
@@ -249,6 +277,7 @@ def main(argv=None) -> int:
         nslock=nslock,
     )
     srv.object_layer = ol
+    _heal_routine, _disk_monitor = start_background_heal(ol)
     si = ol.storage_info()
     print(
         f"minio-tpu serving {len(ol.zones)} zone(s) "

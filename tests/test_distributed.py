@@ -390,6 +390,86 @@ def _thread(fn, *args):
 
 
 @pytest.mark.slow
+def test_verify_healing_node_restart(tmp_path):
+    """verify-healing.sh: write objects, kill a node, wipe one of its
+    drives, restart it - the cluster must converge to fully healed with
+    NO manual heal call (fresh-disk monitor + heal routine)."""
+    import shutil
+
+    ports = [_free_port(), _free_port()]
+    fast_heal = {
+        "MINIO_TPU_FRESH_DISK_INTERVAL_S": "1",
+        "MINIO_TPU_LOCK_REFRESH_S": "1",
+        "MINIO_TPU_LOCK_EXPIRY_S": "4",
+    }
+    procs, endpoints = _spawn_cluster(tmp_path, ports, fast_heal)
+    try:
+        for port in ports:
+            _wait_ready(procs, port)
+        c1 = S3Client(f"http://127.0.0.1:{ports[0]}")
+        assert c1.make_bucket("vhb").status == 200
+        objs = {f"obj{i}": _pay(50_000 + i, seed=20 + i) for i in range(3)}
+        for name, data in objs.items():
+            assert c1.put_object("vhb", name, data).status == 200
+
+        # kill node2, wipe one of its drives (drive swap while down)
+        procs[1].kill()
+        procs[1].wait(timeout=10)
+        victim_root = tmp_path / "n2" / "d1"
+        for entry in os.listdir(victim_root):
+            shutil.rmtree(victim_root / entry)
+
+        # restart node2 with the same endpoint list
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["PYTHONPATH"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        env.update(fast_heal)
+        procs[1] = subprocess.Popen(
+            [
+                sys.executable, "-m", "minio_tpu.server",
+                "--address", f"127.0.0.1:{ports[1]}",
+                "--format-timeout", "60",
+                *endpoints,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        _wait_ready(procs, ports[1])
+
+        # convergence: every object's shard reappears on the wiped
+        # drive without any heal API call
+        deadline = time.monotonic() + 60
+        want = set(objs)
+        while time.monotonic() < deadline:
+            healed = {
+                p.parent.parent.name
+                for p in victim_root.glob("vhb/*/*/part.1")
+            }
+            if want <= healed:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                f"never converged; healed={healed} want={want}"
+            )
+        # data still correct end-to-end from the restarted node
+        c2 = S3Client(f"http://127.0.0.1:{ports[1]}")
+        for name, data in objs.items():
+            r = c2.get_object("vhb", name)
+            assert r.status == 200 and r.body == data
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+                pr.wait(timeout=10)
+
+
+@pytest.mark.slow
 def test_two_node_cluster(tmp_path):
     """verify-healing.sh style: 2 real server processes, one endpoint
     list, writes from one node readable from the other, degraded reads
