@@ -63,7 +63,21 @@ class HealQueue:
                 return None
             task = self._queue.popleft()
             self._pending.discard(task)
+            # in-flight until task_done(): popped-but-unprocessed tasks
+            # must keep drain() waiting (no gap where the queue looks
+            # empty while a heal is mid-run)
+            self._inflight += 1
             return task
+
+    _inflight = 0
+
+    def task_done(self) -> None:
+        with self._mu:
+            self._inflight -= 1
+
+    def idle(self) -> bool:
+        with self._mu:
+            return not self._queue and self._inflight == 0
 
     def __len__(self) -> int:
         with self._mu:
@@ -105,19 +119,16 @@ class HealRoutine:
 
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            if len(self.queue) == 0 and not self._busy:
+            if self.queue.idle():
                 return True
             time.sleep(0.05)
         return False
-
-    _busy = False
 
     def _run(self) -> None:
         while not self._stop.is_set():
             task = self.queue.pop(timeout=0.25)
             if task is None:
                 continue
-            self._busy = True
             try:
                 if task.object:
                     self._ol.heal_object(
@@ -129,7 +140,7 @@ class HealRoutine:
             except Exception:  # noqa: BLE001 - retried by later triggers
                 self.failed += 1
             finally:
-                self._busy = False
+                self.queue.task_done()
             if self._throttle:
                 self._stop.wait(self._throttle)
 
@@ -209,11 +220,8 @@ class FreshDiskMonitor:
                     if fmt is not None:
                         continue
                     # replaced drive: restore staging vol + identity
+                    # (write_format recreates .sys itself)
                     try:
-                        try:
-                            disk.make_vol(".sys")
-                        except Exception:  # noqa: BLE001
-                            pass
                         write_format(
                             disk,
                             FormatErasure(

@@ -254,6 +254,7 @@ def main(argv=None) -> int:
         access_key=args.access_key,
         secret_key=args.secret_key,
         region=args.region,
+        internode_secret=args.secret_key,
     )
     storage_rest = StorageRESTServer(pre_local, args.secret_key)
     srv.register_internode(STORAGE_PREFIX, storage_rest.handle)

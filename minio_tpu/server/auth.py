@@ -150,6 +150,7 @@ class AuthContext:
     streaming: bool = False  # body uses aws-chunked framing
     signed_chunks: bool = False  # each chunk carries a V4 signature
     trailer: bool = False  # trailing checksum headers after last chunk
+    trailer_header: str = ""  # declared x-amz-trailer checksum name
     seed_signature: str = ""
     signing_key: bytes = b""
     amz_date: str = ""
@@ -304,6 +305,8 @@ class SigV4Verifier:
             ctx.trailer = True
         elif payload_hash != UNSIGNED_PAYLOAD:
             ctx.content_sha256 = payload_hash.lower()
+        if ctx.trailer:
+            ctx.trailer_header = headers.get("x-amz-trailer", "").strip().lower()
         want = sign_v4(
             method, path, query, headers, signed_headers, payload_hash,
             access_key, secret, amz_date, region,
@@ -532,6 +535,78 @@ def sign_v2(
 # ---------------------------------------------------------------------------
 
 
+def _crc32c_table() -> list[int]:
+    poly = 0x82F63B78  # Castagnoli, reflected
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_CRC32C_TABLE: "list[int] | None" = None
+
+
+class _Crc32c:
+    """Software CRC32C (no stdlib impl).  Table-driven Python - slow on
+    big bodies, but only runs when a client declares this trailer."""
+
+    def __init__(self):
+        global _CRC32C_TABLE
+        if _CRC32C_TABLE is None:
+            _CRC32C_TABLE = _crc32c_table()
+        self._crc = 0xFFFFFFFF
+
+    def update(self, data: bytes) -> None:
+        crc, table = self._crc, _CRC32C_TABLE
+        for b in data:
+            crc = (crc >> 8) ^ table[(crc ^ b) & 0xFF]
+        self._crc = crc
+
+    def digest(self) -> bytes:
+        return (self._crc ^ 0xFFFFFFFF).to_bytes(4, "big")
+
+
+class _Crc32:
+    def __init__(self):
+        import zlib
+
+        self._z = zlib
+        self._crc = 0
+
+    def update(self, data: bytes) -> None:
+        self._crc = self._z.crc32(data, self._crc)
+
+    def digest(self) -> bytes:
+        return self._crc.to_bytes(4, "big")
+
+
+class _HashlibChecksum:
+    def __init__(self, name: str):
+        self._h = hashlib.new(name)
+
+    def update(self, data: bytes) -> None:
+        self._h.update(data)
+
+    def digest(self) -> bytes:
+        return self._h.digest()
+
+
+def _new_trailer_checksum(header: str):
+    """Incremental checksum for a declared x-amz-checksum-* trailer, or
+    None when the algorithm is unknown (forward compatibility)."""
+    algo = header.rpartition("-")[2]
+    if algo == "crc32":
+        return _Crc32()
+    if algo == "crc32c":
+        return _Crc32c()
+    if algo in ("sha1", "sha256"):
+        return _HashlibChecksum(algo)
+    return None
+
+
 class SigV4ChunkedReader:
     """Decode an aws-chunked body, verifying each chunk's V4 signature.
 
@@ -555,6 +630,11 @@ class SigV4ChunkedReader:
         self._done = False
         self.decoded_length = decoded_length
         self.trailers: "dict[str, str]" = {}
+        self._cksum = (
+            _new_trailer_checksum(ctx.trailer_header)
+            if ctx.trailer and ctx.trailer_header
+            else None
+        )
 
     # internal buffered reads over the raw (already length-limited) stream
 
@@ -634,6 +714,8 @@ class SigV4ChunkedReader:
             raise AuthError("IncompleteBody", "missing chunk CRLF")
         if self._ctx.signed_chunks:
             self._verify_chunk(data)
+        if self._cksum is not None:
+            self._cksum.update(data)
         self._chunk = data
         self._off = 0
 
@@ -694,6 +776,35 @@ class SigV4ChunkedReader:
                 break
             self._next_chunk()
         return bytes(out)
+
+    def finalize(self) -> None:
+        """Drive the terminal 0-chunk + trailer frames to completion.
+
+        Callers stop read()ing once the declared decoded length arrives,
+        which would leave the final chunk signature, trailer signature
+        and trailing checksums unparsed (advisor finding r2) - this
+        consumes and verifies them.  Extra data past the declared length
+        is an error, matching the strict framing of the reference.
+        """
+        while not self._done:
+            if self._off < len(self._chunk):
+                raise AuthError(
+                    "IncompleteBody", "data past declared decoded length"
+                )
+            self._chunk, self._off = b"", 0
+            self._next_chunk()
+            if self._chunk:
+                raise AuthError(
+                    "IncompleteBody", "data past declared decoded length"
+                )
+        if self._cksum is not None:
+            want = self.trailers.get(self._ctx.trailer_header, "")
+            got = base64.b64encode(self._cksum.digest()).decode()
+            if not want or not hmac.compare_digest(got, want):
+                raise AuthError(
+                    "XAmzContentChecksumMismatch",
+                    f"{self._ctx.trailer_header}: want {want!r} got {got!r}",
+                )
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,9 @@ from .s3errors import S3Error
 
 MAX_IN_MEMORY_BODY = 1 << 30  # buffered-body cap (XML configs, POST forms)
 MAX_OBJECT_SIZE = 5 << 40  # globalMaxObjectSize (cmd/globals.go)
+# internode requests are metadata or bounded shard flushes (4 MiB); a
+# larger body is an attack, not a peer (advisor finding r2)
+MAX_INTERNODE_BODY = 64 << 20
 
 
 class _LimitedReader:
@@ -71,8 +74,12 @@ class S3Server:
         secret_key: str = "minioadmin",
         region: str = "us-east-1",
         iam=None,
+        internode_secret: str = "",
     ):
         self.object_layer = object_layer
+        # when set, internode-plane requests must carry a valid JWT
+        # BEFORE the server reads their body (advisor finding r2)
+        self.internode_secret = internode_secret
         host, port = address.rsplit(":", 1)
         self.host, self.port = host, int(port)
         self.region = region
@@ -342,10 +349,36 @@ class _Handler(BaseHTTPRequestHandler):
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = route
 
     def _route_internode(self, handler, method_tail: str, query) -> None:
-        """Dispatch an internode-plane request (JWT auth happens inside
-        the plane handler, storage-rest-server.go:63-104)."""
+        """Dispatch an internode-plane request.
+
+        The bearer JWT is checked BEFORE the body is read and body size
+        is capped, so an unauthenticated client cannot make this node
+        buffer arbitrary bytes (advisor finding r2); plane handlers
+        re-verify on their dispatch path (storage-rest-server.go:63-104)
+        as defense in depth.
+        """
         try:
             length = int(self.headers.get("Content-Length") or 0)
+            if length > MAX_INTERNODE_BODY:
+                self.close_connection = True
+                self._respond(413, b"body too large", content_type="text/plain")
+                return
+            if self.s3.internode_secret:
+                from ..utils import jwt as _jwt
+
+                authz = self.headers.get("Authorization", "")
+                try:
+                    if not authz.startswith("Bearer "):
+                        raise _jwt.JWTError("missing bearer token")
+                    _jwt.verify(
+                        authz[len("Bearer "):], self.s3.internode_secret
+                    )
+                except Exception:  # noqa: BLE001
+                    self.close_connection = True
+                    self._respond(
+                        401, b"unauthorized", content_type="text/plain"
+                    )
+                    return
             body = self.rfile.read(length) if length else b""
             status, payload, extra = handler(
                 method_tail, query, body, dict(self.headers.items())

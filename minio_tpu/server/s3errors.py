@@ -62,6 +62,7 @@ _E = {
     "OperationTimedOut": ("A timeout occurred while trying to lock a resource, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "SlowDown": ("Resource requested is unreadable, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "XAmzContentSHA256Mismatch": ("The provided 'x-amz-content-sha256' header does not match what was computed.", H.BAD_REQUEST),
+    "XAmzContentChecksumMismatch": ("The provided trailing checksum does not match what was computed.", H.BAD_REQUEST),
     "MalformedPOSTRequest": ("The body of your POST request is not well-formed multipart/form-data.", H.BAD_REQUEST),
     "AuthorizationHeaderMalformed": ("The authorization header is malformed.", H.BAD_REQUEST),
     "AuthorizationQueryParametersError": ("Query-string authentication parameters are malformed.", H.BAD_REQUEST),

@@ -123,11 +123,18 @@ class StorageAPI:
         raise NotImplementedError
 
     def append_file(
-        self, volume: str, path: str, data: bytes, truncate: bool = False
+        self,
+        volume: str,
+        path: str,
+        data: bytes,
+        truncate: bool = False,
+        offset: "int | None" = None,
     ) -> None:
         """Append a chunk to a shard file (the storage REST plane's
         bounded-memory CreateFile stream; truncate=True on the first
-        chunk creates/resets the file)."""
+        chunk creates/resets the file).  ``offset`` declares where the
+        chunk starts, making retried appends idempotent (the file is
+        truncated back to it before writing)."""
         raise NotImplementedError
 
     def read_file_stream(self, volume: str, path: str) -> ShardReader:

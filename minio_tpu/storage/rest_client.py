@@ -47,13 +47,18 @@ class RemoteShardWriter(ShardWriter):
         self._path = path
         self._buf = bytearray()
         self._first = True
+        self._off = 0  # bytes acknowledged by the server
 
     def _flush(self) -> None:
-        q = {"vol": self._vol, "path": self._path}
+        # the declared offset makes a retried flush idempotent: the
+        # server truncates back to `off` before appending, so a lost
+        # response cannot duplicate shard bytes (advisor finding r2)
+        q = {"vol": self._vol, "path": self._path, "off": str(self._off)}
         if self._first:
             q["truncate"] = "1"
             self._first = False
         self._c._call("appendfile", q, bytes(self._buf))
+        self._off += len(self._buf)
         del self._buf[:]
 
     def write(self, data: bytes) -> None:

@@ -108,7 +108,11 @@ class StorageRESTServer:
             return wire.pack([st.size, st.mod_time_ns, st.is_dir])
         if m == "appendfile":
             disk.append_file(
-                vol, path, body, truncate=q.get("truncate") == "1"
+                vol,
+                path,
+                body,
+                truncate=q.get("truncate") == "1",
+                offset=int(q["off"]) if "off" in q else None,
             )
             return b""
         if m == "createfile":

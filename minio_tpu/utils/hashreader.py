@@ -65,6 +65,11 @@ class HashReader:
         if self._eof:
             return
         self._eof = True
+        # a framed stream (SigV4ChunkedReader) still holds its terminal
+        # chunk + trailer signatures/checksums - verify them at EOF
+        fin = getattr(self._r, "finalize", None)
+        if fin is not None:
+            fin()
         if 0 <= self.size != self.bytes_read:
             raise SizeMismatch(self.size, self.bytes_read)
         if self._want_md5 and self.md5_hex() != self._want_md5:

@@ -136,7 +136,8 @@ class S3Client:
 
     def put_object_streaming(
         self, bucket, key, data: bytes, chunk_size: int = 64 * 1024,
-        signed: bool = True,
+        signed: bool = True, bad_trailer: bool = False,
+        corrupt_final_sig: bool = False,
     ):
         """Upload with the aws-chunked framing the AWS SDKs/CLI use
         (STREAMING-AWS4-HMAC-SHA256-PAYLOAD)."""
@@ -164,6 +165,9 @@ class S3Client:
             "x-amz-decoded-content-length": str(len(data)),
             "content-encoding": "aws-chunked",
         }
+        if not signed:
+            # declare the trailing checksum like the AWS SDKs do
+            headers["x-amz-trailer"] = "x-amz-checksum-crc32"
         signed_hdrs = sorted(headers)
         sig = auth.sign_v4(
             "PUT", path, {}, headers, signed_hdrs, payload_decl,
@@ -195,13 +199,22 @@ class S3Client:
                     key_bytes, sts.encode(), hashlib.sha256
                 ).hexdigest()
                 prev = csig
+                if corrupt_final_sig and not c:
+                    csig = "0" * 64
                 body += f"{len(c):x};chunk-signature={csig}\r\n".encode()
             else:
                 body += f"{len(c):x}\r\n".encode()
             if c:
                 body += c + b"\r\n"
         if not signed:
-            body += b"x-amz-checksum-crc32:AAAAAA==\r\n"
+            import base64 as b64
+            import zlib
+
+            crc = zlib.crc32(data).to_bytes(4, "big")
+            if bad_trailer:
+                crc = bytes(b ^ 0xFF for b in crc)
+            cksum = b64.b64encode(crc).decode()
+            body += f"x-amz-checksum-crc32:{cksum}\r\n".encode()
         body += b"\r\n"
         conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
         try:

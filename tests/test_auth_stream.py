@@ -523,3 +523,52 @@ def test_streaming_oversize_chunk_header_bounded(server):
         assert b"IncompleteBody" in rbody
     finally:
         conn.close()
+
+
+# -- terminal frame + trailing checksum verification (advisor r2) ---------
+
+
+def test_streaming_bad_trailer_checksum_rejected(client):
+    """A wrong x-amz-checksum-* trailer must fail the upload."""
+    data = _pay(BLOCK + 5, seed=21)
+    r = client.put_object_streaming(
+        "authx", "badtrailer", data, signed=False, bad_trailer=True
+    )
+    assert r.status == 400, r.body
+    assert r.error_code == "XAmzContentChecksumMismatch"
+    assert client.get_object("authx", "badtrailer").status == 404
+
+
+def test_streaming_corrupt_final_chunk_sig_rejected(client):
+    """The zero-size terminal chunk's signature is verified even though
+    no payload bytes remain to read (finalize path)."""
+    data = _pay(BLOCK, seed=22)
+    r = client.put_object_streaming(
+        "authx", "badfinal", data, corrupt_final_sig=True
+    )
+    assert r.status == 403, r.body
+    assert r.error_code == "SignatureDoesNotMatch"
+    assert client.get_object("authx", "badfinal").status == 404
+
+
+def test_crc32c_reference_vector():
+    """CRC32C against the RFC 3720 known-answer vector."""
+    from minio_tpu.server.auth import _Crc32c
+
+    c = _Crc32c()
+    c.update(b"123456789")
+    assert c.digest().hex() == "e3069283"
+
+
+def test_trailer_checksum_sha256(server):
+    """sha256 trailing checksum round-trip (SDK checksum modes)."""
+    import base64
+
+    from minio_tpu.server.auth import _new_trailer_checksum
+
+    h = _new_trailer_checksum("x-amz-checksum-sha256")
+    h.update(b"hello ")
+    h.update(b"world")
+    want = hashlib.sha256(b"hello world").digest()
+    assert h.digest() == want
+    assert _new_trailer_checksum("x-amz-checksum-crc64nvme") is None

@@ -570,3 +570,58 @@ def test_two_node_cluster(tmp_path):
             if pr.poll() is None:
                 pr.kill()
                 pr.wait(timeout=10)
+
+
+def test_remote_writer_retry_has_offsets(remote_pair, tmp_path):
+    """RemoteShardWriter flushes carry explicit offsets so a blind
+    transport retry cannot duplicate shard data."""
+    local, rc = remote_pair
+    local.make_vol("off")
+    w = rc.create_file("off", "shard")
+    w.write(b"x" * 10)
+    w.close()
+    assert local.read_all("off", "shard") == b"x" * 10
+    # replaying the exact first flush (off=0, truncate) is idempotent
+    rc._call(
+        "appendfile",
+        {"vol": "off", "path": "shard", "off": "0", "truncate": "1"},
+        b"x" * 10,
+    )
+    assert local.read_all("off", "shard") == b"x" * 10
+
+
+def test_internode_preauth_rejects_before_body(tmp_path):
+    """An unauthenticated internode request is rejected from its headers
+    alone - the server must not read (buffer) the declared body."""
+    import http.client
+
+    local = XLStorage(str(tmp_path / "pd"))
+    srv = S3Server(
+        None, address="127.0.0.1:0", secret_key=SECRET,
+        internode_secret=SECRET,
+    )
+    srv.register_internode(
+        STORAGE_PREFIX, StorageRESTServer([local], SECRET).handle
+    )
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+        # declare a 10 MiB body but send none: only a server that
+        # answers WITHOUT reading the body can respond in time
+        conn.putrequest("POST", f"{STORAGE_PREFIX}/diskinfo")
+        conn.putheader("Content-Length", str(10 << 20))
+        conn.putheader("Authorization", "Bearer bogus")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 401
+        conn.close()
+        # an oversized body is rejected outright, authenticated or not
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5)
+        conn.putrequest("POST", f"{STORAGE_PREFIX}/diskinfo")
+        conn.putheader("Content-Length", str(1 << 30))
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        conn.close()
+    finally:
+        srv.shutdown()

@@ -167,3 +167,22 @@ def test_disk_info(disk):
     info = disk.disk_info()
     assert info.total > 0
     assert 0 <= info.free <= info.total
+
+
+def test_append_file_offset_idempotent(disk):
+    """A retried append at the same declared offset must converge, not
+    duplicate shard bytes (advisor finding r2: lost-response retry)."""
+    disk.make_vol("av")
+    disk.append_file("av", "f", b"aaaa", truncate=True, offset=0)
+    disk.append_file("av", "f", b"bbbb", offset=4)
+    # lost response: the same flush is retried verbatim
+    disk.append_file("av", "f", b"bbbb", offset=4)
+    disk.append_file("av", "f", b"cc", offset=8)
+    assert disk.read_all("av", "f") == b"aaaabbbbcc"
+    # a gap (offset past EOF) is corruption, not a retry
+    import pytest as _pytest
+
+    from minio_tpu.storage import errors as _errors
+
+    with _pytest.raises(_errors.FileCorrupt):
+        disk.append_file("av", "f", b"dd", offset=99)
