@@ -1,0 +1,274 @@
+"""IAMSys: users, service accounts, named policies + authorization
+(cmd/iam.go:203 IAMSys, cmd/iam-object-store.go).
+
+Identity documents persist as erasure-coded objects under the reserved
+meta volume (``.sys/config/iam/...``, the .minio.sys analogue), so every
+node sees the same IAM state through the object layer and a node restart
+loads it back (iam.go:419 Init).  The in-memory maps are the serving
+path; refresh() re-reads the store (the peer-invalidation stand-in until
+a control plane exists).
+
+Authorization: the root credential bypasses policy (owner); every other
+account is evaluated against its attached named policy, with bucket
+(resource) policies consulted for anonymous and cross-account access by
+the caller (auth dispatch in server/http.py).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import secrets as pysecrets
+import threading
+
+from ..objectlayer.api import META_BUCKET, ObjectNotFound
+from .policy import CANNED_POLICIES, Args, Policy, PolicyError
+
+IAM_PREFIX = "config/iam"
+
+
+class IAMError(Exception):
+    pass
+
+
+class UserNotFound(IAMError):
+    pass
+
+
+class PolicyNotFound(IAMError):
+    pass
+
+
+def generate_credentials() -> "tuple[str, str]":
+    """Access/secret key pair (pkg/auth GetNewCredentials shape)."""
+    alphabet = "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+    ak = "".join(pysecrets.choice(alphabet) for _ in range(20))
+    sk = pysecrets.token_urlsafe(30)[:40]
+    return ak, sk
+
+
+class IAMSys:
+    """In-memory IAM maps + object-layer persistence."""
+
+    def __init__(
+        self,
+        root_access_key: str,
+        root_secret_key: str,
+        object_layer=None,
+    ):
+        self.root_access_key = root_access_key
+        self.root_secret_key = root_secret_key
+        self._ol = object_layer
+        self._mu = threading.RLock()
+        # access_key -> {"secret": str, "policy": str, "status": str,
+        #               "parent": str (service accounts)}
+        self._users: "dict[str, dict]" = {}
+        self._policies: "dict[str, Policy]" = dict(CANNED_POLICIES)
+        if object_layer is not None:
+            self.refresh()
+
+    # -- persistence ------------------------------------------------------
+
+    def _store_path(self, kind: str, name: str) -> str:
+        return f"{IAM_PREFIX}/{kind}/{name}.json"
+
+    def _save_doc(self, kind: str, name: str, doc: dict) -> None:
+        if self._ol is None:
+            return
+        raw = json.dumps(doc).encode()
+        self._ol.put_object(
+            META_BUCKET,
+            self._store_path(kind, name),
+            io.BytesIO(raw),
+            len(raw),
+        )
+
+    def _delete_doc(self, kind: str, name: str) -> None:
+        if self._ol is None:
+            return
+        try:
+            self._ol.delete_object(
+                META_BUCKET, self._store_path(kind, name)
+            )
+        except ObjectNotFound:
+            pass
+
+    def _load_docs(self, kind: str) -> "dict[str, dict]":
+        out: dict = {}
+        if self._ol is None:
+            return out
+        prefix = f"{IAM_PREFIX}/{kind}/"
+        marker = ""
+        while True:
+            res = self._ol.list_objects(
+                META_BUCKET, prefix, marker, "", 1000
+            )
+            for obj in res.objects:
+                name = obj.name[len(prefix):]
+                if not name.endswith(".json"):
+                    continue
+                buf = io.BytesIO()
+                try:
+                    self._ol.get_object(META_BUCKET, obj.name, buf)
+                    out[name[:-5]] = json.loads(buf.getvalue())
+                except Exception:  # noqa: BLE001 - skip corrupt doc
+                    continue
+            if not res.is_truncated:
+                return out
+            marker = res.next_marker
+
+    def refresh(self) -> None:
+        """Reload users + policies from the store (iam.go Load)."""
+        users = self._load_docs("users")
+        policies = self._load_docs("policies")
+        with self._mu:
+            self._users = users
+            self._policies = dict(CANNED_POLICIES)
+            for name, doc in policies.items():
+                try:
+                    self._policies[name] = Policy.from_dict(doc)
+                except PolicyError:
+                    continue
+
+    # -- credential lookup (SigV4Verifier seam) ---------------------------
+
+    def lookup_secret(self, access_key: str) -> "str | None":
+        if access_key == self.root_access_key:
+            return self.root_secret_key
+        with self._mu:
+            u = self._users.get(access_key)
+            if u is None or u.get("status") == "disabled":
+                return None
+            return u["secret"]
+
+    def is_owner(self, access_key: str) -> bool:
+        return access_key == self.root_access_key
+
+    # -- user management (iam.go SetUser/DeleteUser/...) ------------------
+
+    def add_user(
+        self, access_key: str, secret_key: str, policy: str = ""
+    ) -> None:
+        if access_key == self.root_access_key:
+            raise IAMError("cannot shadow the root credential")
+        if policy:
+            self.get_policy(policy)  # must exist
+        doc = {"secret": secret_key, "policy": policy, "status": "enabled"}
+        with self._mu:
+            self._users[access_key] = doc
+        self._save_doc("users", access_key, doc)
+
+    def add_service_account(
+        self, parent: str, access_key: str = "", secret_key: str = ""
+    ) -> "tuple[str, str]":
+        """Service account inheriting the parent user's policy
+        (iam.go NewServiceAccount)."""
+        if parent != self.root_access_key and parent not in self._users:
+            raise UserNotFound(parent)
+        if not access_key:
+            access_key, secret_key = generate_credentials()
+        doc = {
+            "secret": secret_key,
+            "policy": "",
+            "status": "enabled",
+            "parent": parent,
+        }
+        with self._mu:
+            self._users[access_key] = doc
+        self._save_doc("users", access_key, doc)
+        return access_key, secret_key
+
+    def remove_user(self, access_key: str) -> None:
+        with self._mu:
+            if access_key not in self._users:
+                raise UserNotFound(access_key)
+            del self._users[access_key]
+            # drop the user's service accounts too
+            orphans = [
+                ak
+                for ak, u in self._users.items()
+                if u.get("parent") == access_key
+            ]
+            for ak in orphans:
+                del self._users[ak]
+        self._delete_doc("users", access_key)
+        for ak in orphans:
+            self._delete_doc("users", ak)
+
+    def set_user_status(self, access_key: str, enabled: bool) -> None:
+        with self._mu:
+            u = self._users.get(access_key)
+            if u is None:
+                raise UserNotFound(access_key)
+            u["status"] = "enabled" if enabled else "disabled"
+            doc = dict(u)
+        self._save_doc("users", access_key, doc)
+
+    def set_user_policy(self, access_key: str, policy: str) -> None:
+        if policy:
+            self.get_policy(policy)
+        with self._mu:
+            u = self._users.get(access_key)
+            if u is None:
+                raise UserNotFound(access_key)
+            u["policy"] = policy
+            doc = dict(u)
+        self._save_doc("users", access_key, doc)
+
+    def list_users(self) -> "dict[str, dict]":
+        with self._mu:
+            return {
+                ak: {"policy": u.get("policy", ""), "status": u.get("status")}
+                for ak, u in self._users.items()
+                if not u.get("parent")
+            }
+
+    # -- policy management ------------------------------------------------
+
+    def set_policy(self, name: str, policy: Policy) -> None:
+        with self._mu:
+            self._policies[name] = policy
+        self._save_doc("policies", name, policy.to_dict())
+
+    def get_policy(self, name: str) -> Policy:
+        with self._mu:
+            p = self._policies.get(name)
+        if p is None:
+            raise PolicyNotFound(name)
+        return p
+
+    def remove_policy(self, name: str) -> None:
+        with self._mu:
+            if name not in self._policies:
+                raise PolicyNotFound(name)
+            del self._policies[name]
+        if name not in CANNED_POLICIES:
+            self._delete_doc("policies", name)
+
+    def list_policies(self) -> list[str]:
+        with self._mu:
+            return sorted(self._policies)
+
+    # -- authorization (iam.go IsAllowed) ---------------------------------
+
+    def is_allowed(self, args: Args) -> bool:
+        """Identity-policy decision for an authenticated account."""
+        if self.is_owner(args.account):
+            return True
+        with self._mu:
+            u = self._users.get(args.account)
+            if u is None or u.get("status") == "disabled":
+                return False
+            # service accounts inherit the parent's policy
+            parent = u.get("parent")
+            if parent:
+                if self.is_owner(parent):
+                    return True
+                u = self._users.get(parent)
+                if u is None or u.get("status") == "disabled":
+                    return False
+            pname = u.get("policy", "")
+            policy = self._policies.get(pname) if pname else None
+        if policy is None:
+            return False
+        return policy.is_allowed(args)

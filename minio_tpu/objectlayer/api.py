@@ -142,8 +142,18 @@ class CompletePart:
     etag: str
 
 
+META_BUCKET = ".sys"
+
+
 def check_bucket_name(name: str) -> None:
-    """S3 bucket naming rules (IsValidBucketName, pkg bucket rules)."""
+    """S3 bucket naming rules (IsValidBucketName, pkg bucket rules).
+
+    The reserved meta volume is exempt (isMinioMetaBucketName): internal
+    subsystems (IAM, bucket metadata) store erasure-coded documents
+    there through the ordinary ObjectLayer path; the S3 router refuses
+    it before any handler runs (authz.is_reserved_bucket)."""
+    if name == META_BUCKET:
+        return
     if not (3 <= len(name) <= 63):
         raise InvalidBucketName(name)
     if name.startswith((".", "-")) or name.endswith((".", "-")):

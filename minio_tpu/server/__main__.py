@@ -278,6 +278,12 @@ def main(argv=None) -> int:
         nslock=nslock,
     )
     srv.object_layer = ol
+    # store-backed IAM after the object layer is up (iam.go:419 Init)
+    from ..iam.sys import IAMSys
+
+    srv.attach_iam(
+        IAMSys(args.access_key, args.secret_key, ol)
+    )
     _heal_routine, _disk_monitor = start_background_heal(ol)
     si = ol.storage_info()
     print(

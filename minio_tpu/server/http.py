@@ -27,9 +27,11 @@ import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..iam.sys import IAMSys
 from ..objectlayer.api import CompletePart, ObjectInfo
+from ..objectlayer.bucket_meta import BucketMetadataSys
 from ..utils.hashreader import HashReader
-from . import auth as authmod, response as xmlr, s3errors
+from . import auth as authmod, authz, response as xmlr, s3errors
 from .auth import (
     AuthError,
     Credentials,
@@ -43,6 +45,8 @@ MAX_OBJECT_SIZE = 5 << 40  # globalMaxObjectSize (cmd/globals.go)
 # internode requests are metadata or bounded shard flushes (4 MiB); a
 # larger body is an attack, not a peer (advisor finding r2)
 MAX_INTERNODE_BODY = 64 << 20
+# multi-delete bodies carry at most 10k keys (maxDeleteList)
+MAX_MULTI_DELETE_BODY = 1 << 20
 
 
 class _LimitedReader:
@@ -83,17 +87,11 @@ class S3Server:
         host, port = address.rsplit(":", 1)
         self.host, self.port = host, int(port)
         self.region = region
-        self.iam = iam
-        if iam is not None:
-            lookup = iam.lookup_secret
-        else:
-            creds = Credentials(access_key, secret_key)
-            lookup = (
-                lambda ak: creds.secret_key
-                if ak == creds.access_key
-                else None
-            )
-        self.verifier = SigV4Verifier(lookup, region)
+        # every server has an IAMSys; without one injected, a local
+        # (non-persisted) system holding just the root credential
+        self.iam = iam or IAMSys(access_key, secret_key)
+        self.verifier = SigV4Verifier(self.iam.lookup_secret, region)
+        self._bucket_meta: "BucketMetadataSys | None" = None
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         # internode planes (storage/lock/peer/bootstrap REST, the
@@ -102,9 +100,26 @@ class S3Server:
         #           returning (status, body, extra_headers)
         self.internode: "dict[str, object]" = {}
 
+    def attach_iam(self, iam: IAMSys) -> None:
+        """Swap in a store-backed IAMSys once the object layer is up
+        (startBackgroundIAMLoad ordering, server-main.go:529)."""
+        self.iam = iam
+        self.verifier = SigV4Verifier(iam.lookup_secret, self.region)
+
     def register_internode(self, prefix: str, handler) -> None:
         """Mount an internode REST plane under a path prefix."""
         self.internode[prefix] = handler
+
+    @property
+    def bucket_meta(self) -> BucketMetadataSys:
+        """Bucket metadata subsystem, lazily bound once the object
+        layer attaches (it persists through the layer)."""
+        if (
+            self._bucket_meta is None
+            or self._bucket_meta._ol is not self.object_layer
+        ):
+            self._bucket_meta = BucketMetadataSys(self.object_layer)
+        return self._bucket_meta
 
     # -- lifecycle --------------------------------------------------------
 
@@ -332,8 +347,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.command, path, query, dict(self.headers.items())
             )
             self._auth = ctx
-            if ctx.anonymous and not self._is_post_policy(path, query):
-                raise S3Error("AccessDenied")
+            self._authorize(path, query, ctx)
             self._dispatch(path, query)
         except Exception as e:  # noqa: BLE001
             if self._headers_sent:
@@ -347,6 +361,71 @@ class _Handler(BaseHTTPRequestHandler):
             self._finish_body()
 
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = route
+
+    # -- authorization (checkRequestAuthType, auth-handler.go:272) --------
+
+    def _bucket_policy(self, bucket: str):
+        try:
+            return self.s3.bucket_meta.get(bucket).policy()
+        except Exception:  # noqa: BLE001 - missing bucket -> no policy
+            return None
+
+    def _check_action(
+        self, action: str, bucket: str, key: str, account: str
+    ) -> bool:
+        """One policy decision (used per-key by multi-delete too)."""
+        cond = authz.condition_values(
+            {k: v for k, v in self._query.items()},
+            dict(self.headers.items()),
+            self.client_address[0] if self.client_address else "",
+        )
+        return authz.authorize(
+            self.s3.iam,
+            self._bucket_policy(bucket) if bucket else None,
+            account,
+            action,
+            bucket,
+            key,
+            cond,
+        )
+
+    def _authorize(self, path: str, query, ctx) -> None:
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        self._query = query
+        if bucket and authz.is_reserved_bucket(bucket):
+            raise S3Error("AllAccessDisabled")
+        if ctx.anonymous and self._is_post_policy(path, query):
+            # POST form uploads carry their own signature; authorization
+            # happens after the form parses (access key known then)
+            return
+        if self.command == "POST" and not key and "delete" in query:
+            # multi-delete authorizes each named key inside the handler
+            # (DeleteMultipleObjectsHandler); anonymous callers with no
+            # bucket policy at all are cut off before the body is read
+            if ctx.anonymous and self._bucket_policy(bucket) is None:
+                raise S3Error("AccessDenied")
+            return
+        action = authz.action_for_request(
+            self.command, bucket, key, query, dict(self.headers.items())
+        )
+        if not self._check_action(action, bucket, key, ctx.access_key):
+            raise S3Error("AccessDenied")
+        # CopyObject/UploadPartCopy additionally need read access on the
+        # source object
+        if (
+            self.command == "PUT"
+            and key
+            and "x-amz-copy-source" in self.headers
+        ):
+            sb, sk = self._parse_copy_source()
+            if authz.is_reserved_bucket(sb):
+                raise S3Error("AllAccessDisabled")
+            if not self._check_action(
+                "s3:GetObject", sb, sk, ctx.access_key
+            ):
+                raise S3Error("AccessDenied")
 
     def _route_internode(self, handler, method_tail: str, query) -> None:
         """Dispatch an internode-plane request.
@@ -439,28 +518,46 @@ class _Handler(BaseHTTPRequestHandler):
         if m == "GET":
             if "location" in query:
                 return self._respond(200, xmlr.location_xml(""))
+            if "policy" in query:
+                return self._get_bucket_policy(bucket)
             if "uploads" in query:
                 return self._list_uploads(bucket, query)
             if "versioning" in query:
+                ol.get_bucket_info(bucket)
+                state = self.s3.bucket_meta.get(bucket).versioning
+                inner = (
+                    f"<Status>{state}</Status>" if state else ""
+                ).encode()
                 return self._respond(
                     200,
                     b'<?xml version="1.0" encoding="UTF-8"?>\n'
                     b'<VersioningConfiguration xmlns="'
                     + xmlr.S3_NS.encode()
-                    + b'"/>',
+                    + b'">' + inner + b"</VersioningConfiguration>",
                 )
             return self._list_objects(bucket, query)
         if m == "HEAD":
             ol.get_bucket_info(bucket)
             return self._respond(200)
         if m == "PUT":
+            if "policy" in query:
+                return self._put_bucket_policy(bucket, self._read_body())
             ol.make_bucket(bucket)
             return self._respond(200, headers={"Location": f"/{bucket}"})
         if m == "DELETE":
+            if "policy" in query:
+                ol.get_bucket_info(bucket)
+                self.s3.bucket_meta.update(bucket, policy_json="")
+                return self._respond(204)
             ol.delete_bucket(bucket)
+            self.s3.bucket_meta.delete(bucket)
             return self._respond(204)
         if m == "POST":
             if "delete" in query:
+                # multi-delete bodies are key lists, not data: cap far
+                # below the generic buffered-body limit before reading
+                if self._body_size() > MAX_MULTI_DELETE_BODY:
+                    raise S3Error("EntityTooLarge")
                 return self._delete_multiple(bucket, self._read_body())
             if self._is_post_policy(path, query):
                 return self._post_policy(bucket)
@@ -515,6 +612,29 @@ class _Handler(BaseHTTPRequestHandler):
             )
         self._respond(200, body)
 
+    # -- bucket policy (PutBucketPolicyHandler, bucket-policy-handlers.go)
+
+    def _get_bucket_policy(self, bucket: str):
+        self.s3.object_layer.get_bucket_info(bucket)
+        pj = self.s3.bucket_meta.get(bucket).policy_json
+        if not pj:
+            raise S3Error("NoSuchBucketPolicy")
+        self._respond(200, pj.encode(), content_type="application/json")
+
+    def _put_bucket_policy(self, bucket: str, body: bytes):
+        from ..iam.policy import Policy, PolicyError
+
+        self.s3.object_layer.get_bucket_info(bucket)
+        try:
+            pol = Policy.from_json(body)
+            pol.validate_bucket(bucket)
+        except PolicyError as e:
+            raise S3Error("MalformedPolicy", str(e)) from None
+        self.s3.bucket_meta.update(
+            bucket, policy_json=pol.to_json()
+        )
+        self._respond(204)
+
     def _delete_multiple(self, bucket: str, body: bytes):
         try:
             root = ET.fromstring(body)
@@ -525,8 +645,16 @@ class _Handler(BaseHTTPRequestHandler):
             ns = root.tag[: root.tag.index("}") + 1]
         quiet = (root.findtext(f"{ns}Quiet") or "").lower() == "true"
         deleted, errs = [], []
+        account = self._auth.access_key if self._auth else ""
         for obj in root.findall(f"{ns}Object"):
             key = obj.findtext(f"{ns}Key") or ""
+            # per-key authorization (DeleteMultipleObjectsHandler checks
+            # DeleteObject for every named key)
+            if not self._check_action(
+                "s3:DeleteObject", bucket, key, account
+            ):
+                errs.append((key, "AccessDenied", "Access Denied."))
+                continue
             try:
                 self.s3.object_layer.delete_object(bucket, key)
                 if not quiet:
@@ -569,7 +697,13 @@ class _Handler(BaseHTTPRequestHandler):
         form["key"] = key
         form["bucket"] = bucket
         form["content-length"] = str(len(file_data))
-        self.s3.verifier.verify_post_policy(form)
+        post_account = self.s3.verifier.verify_post_policy(form)
+        # the form's signer must hold PutObject (isPutActionAllowed,
+        # auth-handler.go:583)
+        if not self._check_action(
+            "s3:PutObject", bucket, key, post_account
+        ):
+            raise S3Error("AccessDenied")
         meta = {}
         if form.get("content-type"):
             meta["content-type"] = form["content-type"]
@@ -745,13 +879,18 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self._respond(200, b"", {"ETag": f'"{info.etag}"'})
 
-    def _copy_object(self, bucket, key):
+    def _parse_copy_source(self) -> "tuple[str, str]":
+        """(bucket, key) from x-amz-copy-source - one parser for both
+        the authorization and handler sides so they cannot drift."""
         src = urllib.parse.unquote(
             self.headers["x-amz-copy-source"]
         ).lstrip("/")
         if "/" not in src:
             raise S3Error("InvalidArgument", "bad copy source")
-        src_bucket, src_key = src.split("/", 1)
+        return src.split("/", 1)
+
+    def _copy_object(self, bucket, key):
+        src_bucket, src_key = self._parse_copy_source()
         directive = self.headers.get(
             "x-amz-metadata-directive", "COPY"
         )
@@ -788,6 +927,10 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _put_part(self, bucket, key, query):
+        if "x-amz-copy-source" in self.headers:
+            # UploadPartCopy: storing the (empty) request body as the
+            # part would corrupt the upload - refuse until implemented
+            raise S3Error("NotImplemented", "UploadPartCopy")
         uid = query["uploadId"][0]
         try:
             pnum = int(query["partNumber"][0])

@@ -49,6 +49,8 @@ _E = {
     "MissingContentLength": ("You must provide the Content-Length HTTP header.", H.LENGTH_REQUIRED),
     "NoSuchBucket": ("The specified bucket does not exist", H.NOT_FOUND),
     "NoSuchBucketPolicy": ("The bucket policy does not exist", H.NOT_FOUND),
+    "AllAccessDisabled": ("All access to this bucket has been disabled.", H.FORBIDDEN),
+    "MalformedPolicy": ("Policy has invalid resource.", H.BAD_REQUEST),
     "NoSuchKey": ("The specified key does not exist.", H.NOT_FOUND),
     "NoSuchUpload": ("The specified multipart upload does not exist.", H.NOT_FOUND),
     "NoSuchVersion": ("The specified version does not exist.", H.NOT_FOUND),

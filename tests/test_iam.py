@@ -1,0 +1,513 @@
+"""IAM + policy engine tests (pkg/iam/policy conformance subset +
+cmd/auth-handler.go authorization dispatch).
+
+Covers: policy evaluation (deny-wins, wildcards, conditions,
+principals), IAMSys user/policy management + object-layer persistence,
+and the server-level authorization matrix (restricted users, anonymous
+via bucket policy, reserved bucket guard).
+"""
+
+import io
+import json
+
+import pytest
+
+from minio_tpu.iam import Args, CANNED_POLICIES, IAMSys, Policy, PolicyError
+from minio_tpu.objectlayer.bucket_meta import BucketMetadataSys
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+BLOCK = 4096
+
+
+# -- policy engine unit tests ---------------------------------------------
+
+
+def _pol(*statements) -> Policy:
+    return Policy.from_dict(
+        {"Version": "2012-10-17", "Statement": list(statements)}
+    )
+
+
+def test_allow_and_implicit_deny():
+    p = _pol(
+        {
+            "Effect": "Allow",
+            "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::mybucket/*",
+        }
+    )
+    assert p.is_allowed(
+        Args(account="u", action="s3:GetObject", bucket="mybucket", object="x")
+    )
+    assert not p.is_allowed(
+        Args(account="u", action="s3:PutObject", bucket="mybucket", object="x")
+    )
+    assert not p.is_allowed(
+        Args(account="u", action="s3:GetObject", bucket="other", object="x")
+    )
+
+
+def test_deny_overrides_allow():
+    p = _pol(
+        {
+            "Effect": "Allow",
+            "Action": "s3:*",
+            "Resource": "arn:aws:s3:::*",
+        },
+        {
+            "Effect": "Deny",
+            "Action": "s3:DeleteObject",
+            "Resource": "arn:aws:s3:::locked/*",
+        },
+    )
+    assert p.is_allowed(
+        Args(action="s3:DeleteObject", bucket="free", object="x")
+    )
+    assert not p.is_allowed(
+        Args(action="s3:DeleteObject", bucket="locked", object="x")
+    )
+
+
+def test_action_and_resource_wildcards():
+    p = _pol(
+        {
+            "Effect": "Allow",
+            "Action": ["s3:Get*", "s3:List*"],
+            "Resource": ["arn:aws:s3:::data-?/*", "arn:aws:s3:::data-?"],
+        }
+    )
+    assert p.is_allowed(
+        Args(action="s3:GetObject", bucket="data-1", object="k")
+    )
+    assert p.is_allowed(Args(action="s3:ListBucket", bucket="data-2"))
+    assert not p.is_allowed(
+        Args(action="s3:GetObject", bucket="data-10", object="k")
+    )
+
+
+def test_condition_string_equals_prefix():
+    p = _pol(
+        {
+            "Effect": "Allow",
+            "Action": "s3:ListBucket",
+            "Resource": "arn:aws:s3:::b",
+            "Condition": {"StringEquals": {"s3:prefix": "public/"}},
+        }
+    )
+    assert p.is_allowed(
+        Args(
+            action="s3:ListBucket",
+            bucket="b",
+            conditions={"prefix": ["public/"]},
+        )
+    )
+    assert not p.is_allowed(
+        Args(
+            action="s3:ListBucket",
+            bucket="b",
+            conditions={"prefix": ["secret/"]},
+        )
+    )
+    # no prefix supplied at all -> condition fails
+    assert not p.is_allowed(Args(action="s3:ListBucket", bucket="b"))
+
+
+def test_condition_ip_address():
+    p = _pol(
+        {
+            "Effect": "Allow",
+            "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::b/*",
+            "Condition": {
+                "IpAddress": {"aws:SourceIp": "10.0.0.0/8"}
+            },
+        }
+    )
+    ok = Args(
+        action="s3:GetObject", bucket="b", object="k",
+        conditions={"sourceip": ["10.1.2.3"]},
+    )
+    bad = Args(
+        action="s3:GetObject", bucket="b", object="k",
+        conditions={"sourceip": ["192.168.1.1"]},
+    )
+    assert p.is_allowed(ok)
+    assert not p.is_allowed(bad)
+
+
+def test_bucket_policy_principal():
+    p = _pol(
+        {
+            "Effect": "Allow",
+            "Principal": "*",
+            "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::pub/*",
+        }
+    )
+    assert p.is_allowed(
+        Args(account="", action="s3:GetObject", bucket="pub", object="k")
+    )
+    p2 = _pol(
+        {
+            "Effect": "Allow",
+            "Principal": {"AWS": ["alice"]},
+            "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::pub/*",
+        }
+    )
+    assert p2.is_allowed(
+        Args(account="alice", action="s3:GetObject", bucket="pub", object="k")
+    )
+    # anonymous does not match a named principal
+    assert not p2.is_allowed(
+        Args(account="", action="s3:GetObject", bucket="pub", object="k")
+    )
+
+
+def test_validate_bucket_scope():
+    p = _pol(
+        {
+            "Effect": "Allow",
+            "Principal": "*",
+            "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::other/*",
+        }
+    )
+    with pytest.raises(PolicyError):
+        p.validate_bucket("mine")
+    p.validate_bucket("other")
+
+
+def test_canned_policies():
+    ro = CANNED_POLICIES["readonly"]
+    assert ro.is_allowed(Args(action="s3:GetObject", bucket="b", object="k"))
+    assert not ro.is_allowed(
+        Args(action="s3:PutObject", bucket="b", object="k")
+    )
+    rw = CANNED_POLICIES["readwrite"]
+    assert rw.is_allowed(Args(action="s3:DeleteBucket", bucket="b"))
+
+
+def test_policy_json_roundtrip():
+    p = _pol(
+        {
+            "Effect": "Allow",
+            "Action": ["s3:GetObject"],
+            "Resource": ["arn:aws:s3:::b/*"],
+        }
+    )
+    p2 = Policy.from_json(p.to_json())
+    assert p2.is_allowed(Args(action="s3:GetObject", bucket="b", object="k"))
+    with pytest.raises(PolicyError):
+        Policy.from_json("{not json")
+    with pytest.raises(PolicyError):
+        Policy.from_json(json.dumps({"Statement": [{"Effect": "Maybe"}]}))
+
+
+# -- IAMSys ----------------------------------------------------------------
+
+
+@pytest.fixture()
+def layer(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    return ErasureObjects(disks, block_size=BLOCK)
+
+
+def test_iamsys_users_and_eval(layer):
+    iam = IAMSys("root", "rootsecret", layer)
+    iam.add_user("alice", "alicesecret", "readonly")
+    assert iam.lookup_secret("alice") == "alicesecret"
+    assert iam.lookup_secret("root") == "rootsecret"
+    assert iam.lookup_secret("nobody") is None
+    assert iam.is_allowed(
+        Args(account="root", action="s3:DeleteBucket", bucket="b")
+    )
+    assert iam.is_allowed(
+        Args(account="alice", action="s3:GetObject", bucket="b", object="k")
+    )
+    assert not iam.is_allowed(
+        Args(account="alice", action="s3:PutObject", bucket="b", object="k")
+    )
+    iam.set_user_status("alice", enabled=False)
+    assert iam.lookup_secret("alice") is None
+    assert not iam.is_allowed(
+        Args(account="alice", action="s3:GetObject", bucket="b", object="k")
+    )
+
+
+def test_iamsys_persistence(layer):
+    iam = IAMSys("root", "rs", layer)
+    custom = _pol(
+        {
+            "Effect": "Allow",
+            "Action": "s3:*",
+            "Resource": "arn:aws:s3:::only-this/*",
+        }
+    )
+    iam.set_policy("scoped", custom)
+    iam.add_user("bob", "bobsecret", "scoped")
+    # a fresh IAMSys over the same layer loads the same state
+    iam2 = IAMSys("root", "rs", layer)
+    assert iam2.lookup_secret("bob") == "bobsecret"
+    assert iam2.is_allowed(
+        Args(
+            account="bob", action="s3:PutObject",
+            bucket="only-this", object="k",
+        )
+    )
+    assert not iam2.is_allowed(
+        Args(account="bob", action="s3:PutObject", bucket="other", object="k")
+    )
+    iam2.remove_user("bob")
+    iam3 = IAMSys("root", "rs", layer)
+    assert iam3.lookup_secret("bob") is None
+
+
+def test_iamsys_service_account(layer):
+    iam = IAMSys("root", "rs", layer)
+    iam.add_user("carol", "cs", "readwrite")
+    ak, sk = iam.add_service_account("carol")
+    assert iam.lookup_secret(ak) == sk
+    # inherits carol's readwrite policy
+    assert iam.is_allowed(
+        Args(account=ak, action="s3:PutObject", bucket="b", object="k")
+    )
+    # removing the parent removes the service account
+    iam.remove_user("carol")
+    assert iam.lookup_secret(ak) is None
+
+
+def test_bucket_metadata_sys(layer):
+    layer.make_bucket("bmx")
+    sys_ = BucketMetadataSys(layer)
+    assert sys_.get("bmx").policy_json == ""
+    sys_.update("bmx", versioning="Enabled")
+    assert sys_.get("bmx").versioning_enabled
+    # a second subsystem instance reads the persisted doc
+    sys2 = BucketMetadataSys(layer)
+    assert sys2.get("bmx").versioning == "Enabled"
+    sys_.delete("bmx")
+    assert BucketMetadataSys(layer).get("bmx").versioning == ""
+
+
+# -- server authorization matrix ------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def iam_server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("iamsrv")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK)
+    iam = IAMSys("minioadmin", "minioadmin", ol)
+    srv = S3Server(ol, address="127.0.0.1:0", iam=iam).start()
+    yield srv, iam
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def root_client(iam_server):
+    srv, _ = iam_server
+    c = S3Client(srv.endpoint)
+    c.make_bucket("shared")
+    c.make_bucket("private")
+    c.put_object("shared", "hello.txt", b"hello world")
+    c.put_object("private", "secret.txt", b"top secret")
+    return c
+
+
+def test_restricted_user_single_bucket(iam_server, root_client):
+    srv, iam = iam_server
+    iam.set_policy(
+        "shared-rw",
+        _pol(
+            {
+                "Effect": "Allow",
+                "Action": ["s3:GetObject", "s3:PutObject", "s3:ListBucket"],
+                "Resource": [
+                    "arn:aws:s3:::shared/*",
+                    "arn:aws:s3:::shared",
+                ],
+            }
+        ),
+    )
+    iam.add_user("dave", "davesecret123", "shared-rw")
+    dave = S3Client(srv.endpoint, "dave", "davesecret123")
+    assert dave.get_object("shared", "hello.txt").body == b"hello world"
+    assert dave.put_object("shared", "mine.txt", b"ok").status == 200
+    r = dave.get_object("private", "secret.txt")
+    assert r.status == 403 and r.error_code == "AccessDenied"
+    assert dave.put_object("private", "x", b"no").status == 403
+    # bucket-level denied elsewhere
+    assert dave.list_objects("private").status == 403
+    assert dave.list_objects("shared").status == 200
+    # delete not granted even on shared
+    assert dave.delete_object("shared", "mine.txt").status == 403
+
+
+def test_readonly_user(iam_server, root_client):
+    srv, iam = iam_server
+    iam.add_user("erin", "erinsecret123", "readonly")
+    erin = S3Client(srv.endpoint, "erin", "erinsecret123")
+    assert erin.get_object("shared", "hello.txt").status == 200
+    assert erin.put_object("shared", "nope", b"x").status == 403
+    # ListBucket is NOT part of readonly (GetBucketLocation+GetObject)
+    assert erin.list_objects("shared").status == 403
+
+
+def test_unknown_access_key(iam_server, root_client):
+    srv, _ = iam_server
+    ghost = S3Client(srv.endpoint, "ghost", "ghostsecret")
+    r = ghost.get_object("shared", "hello.txt")
+    assert r.status == 403
+    assert r.error_code == "InvalidAccessKeyId"
+
+
+def test_anonymous_via_bucket_policy(iam_server, root_client):
+    srv, _ = iam_server
+    c = root_client
+    # no policy yet: anonymous denied
+    anon = S3Client(srv.endpoint)
+    r = anon.request("GET", "/shared/hello.txt", sign=False)
+    assert r.status == 403
+    # grant anonymous read via bucket policy
+    pol = {
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Principal": "*",
+                "Action": ["s3:GetObject"],
+                "Resource": ["arn:aws:s3:::shared/*"],
+            }
+        ],
+    }
+    r = c.request(
+        "PUT", "/shared", query={"policy": ""},
+        body=json.dumps(pol).encode(),
+    )
+    assert r.status == 204, r.body
+    r = anon.request("GET", "/shared/hello.txt", sign=False)
+    assert r.status == 200 and r.body == b"hello world"
+    # anonymous write still denied
+    r = anon.request("PUT", "/shared/evil", body=b"x", sign=False)
+    assert r.status == 403
+    # policy round-trip + delete
+    r = c.request("GET", "/shared", query={"policy": ""})
+    assert r.status == 200
+    assert json.loads(r.body)["Statement"][0]["Action"] == ["s3:GetObject"]
+    assert c.request("DELETE", "/shared", query={"policy": ""}).status == 204
+    r = c.request("GET", "/shared", query={"policy": ""})
+    assert r.status == 404 and r.error_code == "NoSuchBucketPolicy"
+    assert anon.request("GET", "/shared/hello.txt", sign=False).status == 403
+
+
+def test_bucket_policy_validation(iam_server, root_client):
+    c = root_client
+    # policy naming a different bucket is rejected
+    pol = {
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Principal": "*",
+                "Action": ["s3:GetObject"],
+                "Resource": ["arn:aws:s3:::other/*"],
+            }
+        ],
+    }
+    r = c.request(
+        "PUT", "/shared", query={"policy": ""},
+        body=json.dumps(pol).encode(),
+    )
+    assert r.status == 400 and r.error_code == "MalformedPolicy"
+
+
+def test_reserved_bucket_blocked(iam_server, root_client):
+    srv, _ = iam_server
+    c = root_client
+    r = c.request("GET", "/.sys/config/iam/users/dave.json")
+    assert r.status == 403
+    assert r.error_code == "AllAccessDisabled"
+    r = c.request("PUT", "/.sys/evil", body=b"x")
+    assert r.status == 403
+
+
+def test_multi_delete_per_key_authz(iam_server, root_client):
+    srv, iam = iam_server
+    c = root_client
+    c.put_object("shared", "md1", b"1")
+    c.put_object("shared", "md2", b"2")
+    iam.set_policy(
+        "no-delete",
+        _pol(
+            {
+                "Effect": "Allow",
+                "Action": ["s3:GetObject"],
+                "Resource": ["arn:aws:s3:::shared/*"],
+            }
+        ),
+    )
+    iam.add_user("frank", "franksecret12", "no-delete")
+    frank = S3Client(srv.endpoint, "frank", "franksecret12")
+    body = (
+        b'<Delete><Object><Key>md1</Key></Object>'
+        b'<Object><Key>md2</Key></Object></Delete>'
+    )
+    r = frank.request(
+        "POST", "/shared", query={"delete": ""}, body=body
+    )
+    assert r.status == 200
+    # every key individually denied
+    assert r.body.count(b"AccessDenied") == 2
+    # objects survived
+    assert c.get_object("shared", "md1").status == 200
+
+
+def test_copy_source_authz_not_bypassed_by_partnumber(
+    iam_server, root_client
+):
+    """PUT ?partNumber with x-amz-copy-source must still authorize
+    s3:GetObject on the source (review finding: privilege escalation)."""
+    srv, iam = iam_server
+    iam.set_policy(
+        "put-only-shared",
+        _pol(
+            {
+                "Effect": "Allow",
+                "Action": ["s3:PutObject"],
+                "Resource": ["arn:aws:s3:::shared/*"],
+            }
+        ),
+    )
+    iam.add_user("mallory", "mallorysecret", "put-only-shared")
+    m = S3Client(srv.endpoint, "mallory", "mallorysecret")
+    r = m.request(
+        "PUT", "/shared/stolen", query={"partNumber": "1"},
+        headers={"x-amz-copy-source": "/private/secret.txt"},
+    )
+    assert r.status == 403, r.body
+    # plain CopyObject equally denied
+    r = m.request(
+        "PUT", "/shared/stolen2",
+        headers={"x-amz-copy-source": "/private/secret.txt"},
+    )
+    assert r.status == 403
+
+
+def test_upload_part_copy_not_implemented(iam_server, root_client):
+    """UploadPartCopy must refuse rather than store the empty body."""
+    c = root_client
+    r = c.request("POST", "/shared/mpk", query={"uploads": ""})
+    assert r.status == 200
+    uid = r.xml_text("UploadId")
+    r = c.request(
+        "PUT", "/shared/mpk",
+        query={"partNumber": "1", "uploadId": uid},
+        headers={"x-amz-copy-source": "/shared/hello.txt"},
+    )
+    assert r.status == 501
+    c.request("DELETE", "/shared/mpk", query={"uploadId": uid})
