@@ -114,6 +114,18 @@ class ListObjectsInfo:
 
 
 @dataclasses.dataclass
+class ListObjectVersionsInfo:
+    """ListObjectVersions result: versions + delete markers interleaved
+    newest-first per key (ListObjectVersions, cmd/object-api-datatypes.go)."""
+
+    is_truncated: bool = False
+    next_key_marker: str = ""
+    next_version_id_marker: str = ""
+    versions: list = dataclasses.field(default_factory=list)
+    prefixes: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class ListMultipartsInfo:
     uploads: list = dataclasses.field(default_factory=list)
     is_truncated: bool = False

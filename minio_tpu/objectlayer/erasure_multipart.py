@@ -25,6 +25,7 @@ from ..storage.meta import (
     ErasureInfo,
     FileInfo,
     ObjectPartInfo,
+    new_version_id,
     now_ns,
 )
 from ..utils.hashreader import HashReader
@@ -279,6 +280,7 @@ class MultipartMixin:
 
     def complete_multipart_upload(
         self, bucket, object_name, upload_id, parts: list[CompletePart],
+        versioned=False,
     ) -> ObjectInfo:
         self._require_bucket(bucket)
         mfi = self._mp_read_meta(upload_id)
@@ -330,12 +332,12 @@ class MultipartMixin:
         meta["etag"] = final_etag
 
         with self.nslock.write(bucket, object_name):
-            old_data_dir = ""
-            try:
-                old_fi = self._read_quorum_fileinfo(bucket, object_name)[0]
-                old_data_dir = old_fi.data_dir
-            except Exception:  # noqa: BLE001
-                pass
+            version_id = new_version_id() if versioned else ""
+            old_data_dir = (
+                ""
+                if versioned
+                else self._old_null_data_dir(bucket, object_name)
+            )
             errs = []
             staged: list[tuple] = []  # (disk, tmp) that moved parts out
             for i, d in enumerate(disks):
@@ -346,6 +348,7 @@ class MultipartMixin:
                 fi = FileInfo(
                     volume=bucket,
                     name=object_name,
+                    version_id=version_id,
                     data_dir=data_dir,
                     size=total,
                     mod_time_ns=mod_time,
@@ -431,5 +434,6 @@ class MultipartMixin:
             mod_time_ns=mod_time,
             etag=final_etag,
             content_type=meta.get("content-type", ""),
+            version_id=version_id,
             user_defined=meta,
         )

@@ -188,17 +188,31 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     # ------------------------------------------------------------------
 
     def put_object(
-        self, bucket, object_name, reader, size=-1, metadata=None
+        self, bucket, object_name, reader, size=-1, metadata=None,
+        versioned=False,
     ) -> ObjectInfo:
         check_object_name(object_name)
         self._require_bucket(bucket)
         with self.nslock.write(bucket, object_name):
             return self._put_object(
-                bucket, object_name, reader, size, metadata
+                bucket, object_name, reader, size, metadata, versioned
             )
 
+    def _old_null_data_dir(self, bucket, object_name) -> str:
+        """Data dir of the existing *null* version, if any - the only
+        version an unversioned overwrite replaces (and so the only data
+        dir safe to reap; real versions keep theirs)."""
+        try:
+            fi, _ = self._read_quorum_fileinfo(
+                bucket, object_name, "null"
+            )
+            return fi.data_dir
+        except Exception:  # noqa: BLE001
+            return ""
+
     def _put_object(
-        self, bucket, object_name, reader, size, metadata
+        self, bucket, object_name, reader, size, metadata,
+        versioned=False,
     ) -> ObjectInfo:
         k, m, n = self.data_blocks, self.parity_blocks, len(self.disks)
         er = Erasure(k, m, self.block_size)
@@ -240,13 +254,13 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         etag = hreader.etag()
         meta = dict(metadata or {})
         meta.setdefault("etag", etag)
-        # previous version's data dir (for overwrite cleanup)
-        old_data_dir = ""
-        try:
-            old_fi = self._read_quorum_fileinfo(bucket, object_name)[0]
-            old_data_dir = old_fi.data_dir
-        except Exception:  # noqa: BLE001
-            pass
+        # versioned PUT mints a fresh id and preserves prior versions;
+        # unversioned/suspended PUT overwrites the null version only
+        # (xl-storage-format-v2 version journal semantics)
+        version_id = new_version_id() if versioned else ""
+        old_data_dir = (
+            "" if versioned else self._old_null_data_dir(bucket, object_name)
+        )
 
         errs = []
         for i, d in enumerate(disks):
@@ -256,7 +270,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             fi = FileInfo(
                 volume=bucket,
                 name=object_name,
-                version_id="",
+                version_id=version_id,
                 data_dir=data_dir,
                 size=total,
                 mod_time_ns=mod_time,
@@ -311,6 +325,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             mod_time_ns=mod_time,
             etag=etag,
             content_type=meta.get("content-type", ""),
+            version_id=version_id,
             user_defined=meta,
         )
 
@@ -466,11 +481,16 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     # ------------------------------------------------------------------
 
     def delete_object(
-        self, bucket, object_name, version_id=""
+        self, bucket, object_name, version_id="", versioned=False,
+        version_suspended=False,
     ) -> ObjectInfo:
         check_object_name(object_name)
         self._require_bucket(bucket)
         with self.nslock.write(bucket, object_name):
+            if not version_id and (versioned or version_suspended):
+                return self._write_delete_marker(
+                    bucket, object_name, versioned
+                )
             fi, _ = self._read_quorum_fileinfo(
                 bucket, object_name, version_id
             )
@@ -493,8 +513,63 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     errs.append(e)
             reduce_errs(errs, self.write_quorum, WriteQuorumError)
             return ObjectInfo(
-                bucket=bucket, name=object_name, version_id=version_id
+                bucket=bucket,
+                name=object_name,
+                version_id=version_id,
+                delete_marker=fi.deleted if version_id else False,
             )
+
+    def _write_delete_marker(
+        self, bucket, object_name, versioned: bool
+    ) -> ObjectInfo:
+        """Unqualified DELETE on a versioning-configured bucket appends
+        a delete marker instead of removing data
+        (xl-storage-format-v2.go xlMetaV2DeleteMarker).  Suspended
+        buckets write the *null* marker, replacing the null version."""
+        marker_vid = new_version_id() if versioned else ""
+        mod_time = now_ns()
+        old_null_dir = (
+            "" if versioned else self._old_null_data_dir(bucket, object_name)
+        )
+        fi = FileInfo(
+            volume=bucket,
+            name=object_name,
+            version_id=marker_vid,
+            deleted=True,
+            mod_time_ns=mod_time,
+        )
+        errs = []
+        disks = self._online_disks()
+        for d in disks:
+            if d is None:
+                errs.append(serrors.DiskNotFound("offline"))
+                continue
+            try:
+                d.write_metadata(bucket, object_name, fi)
+                errs.append(None)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        reduce_errs(errs, self.write_quorum, WriteQuorumError)
+        if old_null_dir:
+            # the replaced null version's data is unreferenced now
+            for d in disks:
+                if d is None:
+                    continue
+                try:
+                    d.delete_file(
+                        bucket,
+                        f"{object_name}/{old_null_dir}",
+                        recursive=True,
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+        return ObjectInfo(
+            bucket=bucket,
+            name=object_name,
+            version_id=marker_vid,
+            delete_marker=True,
+            mod_time_ns=mod_time,
+        )
 
     # ------------------------------------------------------------------
     # copy
@@ -502,7 +577,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
     def copy_object(
         self, src_bucket, src_object, dst_bucket, dst_object,
-        metadata=None,
+        metadata=None, versioned=False,
     ) -> ObjectInfo:
         import io
 
@@ -515,7 +590,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             meta.update(metadata)
         meta.pop("etag", None)
         return self.put_object(
-            dst_bucket, dst_object, buf, src_info.size, meta
+            dst_bucket, dst_object, buf, src_info.size, meta,
+            versioned=versioned,
         )
 
     # ------------------------------------------------------------------
@@ -574,6 +650,118 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             out.objects.append(self._to_object_info(bucket, name, fi))
             count += 1
             last_key = name
+        return out
+
+    # ------------------------------------------------------------------
+    # version listing (ListObjectVersions merge)
+    # ------------------------------------------------------------------
+
+    def _read_version_journal(
+        self, bucket, object_name
+    ) -> "list[FileInfo]":
+        """Merged, quorum-checked version journal for one object: every
+        disk's xl.meta read, versions grouped by id, kept when at least
+        read_quorum disks agree, newest first."""
+        groups: "dict[str, list[FileInfo]]" = {}
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                xl = d.read_xl(bucket, object_name)
+            except Exception:  # noqa: BLE001
+                continue
+            for v in xl.versions:
+                groups.setdefault(v.version_id or "null", []).append(v)
+        out: list[FileInfo] = []
+        for vid, vs in groups.items():
+            if len(vs) < self.read_quorum:
+                continue
+            fi = vs[0]
+            fi.volume, fi.name = bucket, object_name
+            out.append(fi)
+        out.sort(key=lambda v: -v.mod_time_ns)
+        for i, fi in enumerate(out):
+            fi.is_latest = i == 0
+        return out
+
+    def has_object_versions(self, bucket, object_name) -> bool:
+        """Any journal entry at all (incl. delete markers) - used by the
+        zone router, where get_object_info hides marker-latest keys."""
+        return bool(self._read_version_journal(bucket, object_name))
+
+    def list_object_versions(
+        self, bucket, prefix="", key_marker="", version_id_marker="",
+        delimiter="", max_keys=1000,
+    ) -> api.ListObjectVersionsInfo:
+        self._require_bucket(bucket)
+        max_keys = max(0, min(max_keys, 1000))
+        names: set[str] = set()
+        for d in self._online_disks():
+            if d is None:
+                continue
+            try:
+                names.update(d.walk(bucket))
+            except Exception:  # noqa: BLE001
+                continue
+        out = api.ListObjectVersionsInfo()
+        seen_prefixes: set[str] = set()
+        count = 0
+        last = (key_marker, version_id_marker)  # last emitted (key, vid)
+        for name in sorted(names):
+            if prefix and not name.startswith(prefix):
+                continue
+            if delimiter:
+                rest = name[len(prefix):]
+                di = rest.find(delimiter)
+                if di >= 0:
+                    cp = prefix + rest[: di + len(delimiter)]
+                    if cp <= key_marker:
+                        continue
+                    if cp not in seen_prefixes:
+                        if count >= max_keys:
+                            out.is_truncated = True
+                            out.next_key_marker = last[0]
+                            out.next_version_id_marker = last[1]
+                            return out
+                        seen_prefixes.add(cp)
+                        out.prefixes.append(cp)
+                        count += 1
+                        last = (cp, "")
+                    continue
+            if key_marker and name < key_marker:
+                continue
+            versions = self._read_version_journal(bucket, name)
+            resumed = False
+            if name == key_marker and version_id_marker:
+                # if the marker version vanished between pages (deleted
+                # concurrently), emit the whole key again - duplicates
+                # beat silently dropping every remaining version
+                if not any(
+                    (fi.version_id or "null") == version_id_marker
+                    for fi in versions
+                ):
+                    resumed = True
+            for fi in versions:
+                vid = fi.version_id or "null"
+                if name == key_marker and not resumed:
+                    # resume inside this key's version list: skip up to
+                    # and including the version-id marker (no marker =
+                    # the whole key was emitted last page)
+                    if not version_id_marker:
+                        continue
+                    if vid == version_id_marker:
+                        resumed = True
+                    continue
+                if count >= max_keys:
+                    out.is_truncated = True
+                    out.next_key_marker, out.next_version_id_marker = last
+                    return out
+                oi = self._to_object_info(bucket, name, fi)
+                oi.is_latest = fi.is_latest
+                oi.version_id = vid
+                out.versions.append(oi)
+                count += 1
+                last = (name, vid)
         return out
 
     # ------------------------------------------------------------------

@@ -95,9 +95,10 @@ class ErasureSets(ObjectLayer):
 
     # -- objects (route by key) -------------------------------------------
 
-    def put_object(self, bucket, object_name, reader, size=-1, metadata=None):
+    def put_object(self, bucket, object_name, reader, size=-1, metadata=None,
+                   versioned=False):
         return self.set_for(object_name).put_object(
-            bucket, object_name, reader, size, metadata
+            bucket, object_name, reader, size, metadata, versioned
         )
 
     def get_object(self, bucket, object_name, writer, offset=0, length=-1,
@@ -111,20 +112,22 @@ class ErasureSets(ObjectLayer):
             bucket, object_name, version_id
         )
 
-    def delete_object(self, bucket, object_name, version_id=""):
+    def delete_object(self, bucket, object_name, version_id="",
+                      versioned=False, version_suspended=False):
         return self.set_for(object_name).delete_object(
-            bucket, object_name, version_id
+            bucket, object_name, version_id, versioned, version_suspended
         )
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
-                    metadata=None):
+                    metadata=None, versioned=False):
         import io
 
         src_set = self.set_for(src_object)
         dst_set = self.set_for(dst_object)
         if src_set is dst_set:
             return src_set.copy_object(
-                src_bucket, src_object, dst_bucket, dst_object, metadata
+                src_bucket, src_object, dst_bucket, dst_object, metadata,
+                versioned,
             )
         info = src_set.get_object_info(src_bucket, src_object)
         buf = io.BytesIO()
@@ -135,7 +138,8 @@ class ErasureSets(ObjectLayer):
             meta.update(metadata)
         meta.pop("etag", None)
         return dst_set.put_object(
-            dst_bucket, dst_object, buf, info.size, meta
+            dst_bucket, dst_object, buf, info.size, meta,
+            versioned=versioned,
         )
 
     def heal_object(self, bucket, object_name, version_id="", dry_run=False):
@@ -169,6 +173,23 @@ class ErasureSets(ObjectLayer):
         ]
         return merge_list_results(results, max_keys)
 
+    def has_object_versions(self, bucket, object_name) -> bool:
+        return self.set_for(object_name).has_object_versions(
+            bucket, object_name
+        )
+
+    def list_object_versions(self, bucket, prefix="", key_marker="",
+                             version_id_marker="", delimiter="",
+                             max_keys=1000):
+        results = [
+            s.list_object_versions(
+                bucket, prefix, key_marker, version_id_marker,
+                delimiter, max_keys,
+            )
+            for s in self.sets
+        ]
+        return merge_version_results(results, max_keys)
+
     # -- multipart (route by key) -----------------------------------------
 
     def new_multipart_upload(self, bucket, object_name, metadata=None):
@@ -201,9 +222,9 @@ class ErasureSets(ObjectLayer):
         )
 
     def complete_multipart_upload(self, bucket, object_name, upload_id,
-                                  parts):
+                                  parts, versioned=False):
         return self.set_for(object_name).complete_multipart_upload(
-            bucket, object_name, upload_id, parts
+            bucket, object_name, upload_id, parts, versioned
         )
 
     def storage_info(self) -> dict:
@@ -216,29 +237,93 @@ class ErasureSets(ObjectLayer):
         }
 
 
+def _truncation_boundary(results: list, marker_attr: str) -> "str | None":
+    """Lowest last-emitted key among truncated inputs.  A merged page
+    must not emit entries PAST a truncated input's boundary: that input
+    has unreturned keys below them, and a resume marker beyond the
+    boundary would skip those keys forever (review finding r3)."""
+    bounds = [
+        getattr(r, marker_attr)
+        for r in results
+        if r.is_truncated and getattr(r, marker_attr)
+    ]
+    return min(bounds) if bounds else None
+
+
+def merge_version_results(results: list, max_keys: int):
+    """Version-aware lexical merge across sets/zones: entries key on
+    (object name, newest-first position) - each key's versions stay
+    contiguous and ordered, truncation re-applied at max_keys and at
+    the lowest truncated input's boundary."""
+    per_key: "dict[str, list]" = {}
+    prefixes: set[str] = set()
+    for r in results:
+        prefixes.update(r.prefixes)
+        for oi in r.versions:
+            per_key.setdefault(oi.name, []).append(oi)
+    boundary = _truncation_boundary(results, "next_key_marker")
+    out = api.ListObjectVersionsInfo()
+    entries = sorted(
+        [(name, "o") for name in per_key]
+        + [(p, "p") for p in prefixes]
+    )
+    count = 0
+    for name, kind in entries:
+        if boundary is not None and name > boundary:
+            out.is_truncated = True
+            return out
+        if kind == "p":
+            if count >= max_keys:
+                out.is_truncated = True
+                return out
+            out.prefixes.append(name)
+            out.next_key_marker = name
+            out.next_version_id_marker = ""
+            count += 1
+            continue
+        versions = sorted(
+            per_key[name], key=lambda o: -o.mod_time_ns
+        )
+        for oi in versions:
+            if count >= max_keys:
+                out.is_truncated = True
+                return out
+            out.versions.append(oi)
+            count += 1
+            out.next_key_marker = name
+            out.next_version_id_marker = oi.version_id or "null"
+    out.is_truncated = boundary is not None
+    return out
+
+
 def merge_list_results(
     results: list[ListObjectsInfo], max_keys: int
 ) -> ListObjectsInfo:
     """Lexical merge of per-set/per-zone listings, re-truncated to
-    max_keys (lexicallySortedEntry, erasure-sets.go:842)."""
+    max_keys and to the lowest truncated input's boundary
+    (lexicallySortedEntry, erasure-sets.go:842)."""
     objects = {o.name: o for r in results for o in r.objects}
     prefixes = {p for r in results for p in r.prefixes}
+    boundary = _truncation_boundary(results, "next_marker")
     entries = sorted(
         [(name, "o") for name in objects] + [(p, "p") for p in prefixes]
     )
     out = ListObjectsInfo()
-    truncated_tail = any(r.is_truncated for r in results)
-    for i, (name, kind) in enumerate(entries):
+    last = ""
+    for name, kind in entries:
+        if boundary is not None and name > boundary:
+            out.is_truncated = True
+            out.next_marker = last
+            return out
         if len(out.objects) + len(out.prefixes) >= max_keys:
             out.is_truncated = True
-            out.next_marker = entries[i - 1][0] if i else ""
-            break
+            out.next_marker = last
+            return out
         if kind == "o":
             out.objects.append(objects[name])
         else:
             out.prefixes.append(name)
-    else:
-        out.is_truncated = truncated_tail
-        if truncated_tail and entries:
-            out.next_marker = entries[-1][0]
+        last = name
+    out.is_truncated = boundary is not None
+    out.next_marker = last if out.is_truncated else ""
     return out

@@ -107,11 +107,12 @@ class ErasureZones(ObjectLayer):
 
     # -- objects ----------------------------------------------------------
 
-    def put_object(self, bucket, object_name, reader, size=-1, metadata=None):
+    def put_object(self, bucket, object_name, reader, size=-1, metadata=None,
+                   versioned=False):
         self.zones[0].get_bucket_info(bucket)  # bucket must exist
         zi = self._put_zone_index(bucket, object_name)
         return self.zones[zi].put_object(
-            bucket, object_name, reader, size, metadata
+            bucket, object_name, reader, size, metadata, versioned
         )
 
     def get_object(self, bucket, object_name, writer, offset=0, length=-1,
@@ -127,13 +128,42 @@ class ErasureZones(ObjectLayer):
         z = self._find_zone(bucket, object_name, version_id)
         return z.get_object_info(bucket, object_name, version_id)
 
-    def delete_object(self, bucket, object_name, version_id=""):
+    def _zone_with_versions(self, bucket, object_name):
+        """First zone holding ANY journal entry for the key (incl.
+        delete markers, which get_object_info cannot see)."""
+        return next(
+            (
+                z
+                for z in self.zones
+                if z.has_object_versions(bucket, object_name)
+            ),
+            None,
+        )
+
+    def delete_object(self, bucket, object_name, version_id="",
+                      versioned=False, version_suspended=False):
         self.zones[0].get_bucket_info(bucket)
-        z = self._find_zone(bucket, object_name, version_id)
+        if not version_id and (versioned or version_suspended):
+            # marker goes to the object's zone, or the write zone when
+            # the key never existed (AWS still mints a marker)
+            z = self._zone_with_versions(bucket, object_name)
+            if z is None:
+                z = self.zones[self._put_zone_index(bucket, object_name)]
+            return z.delete_object(
+                bucket, object_name, "", versioned, version_suspended
+            )
+        try:
+            z = self._find_zone(bucket, object_name, version_id)
+        except (api.ObjectNotFound, api.VersionNotFound):
+            # the named version may be a delete marker, invisible to
+            # get_object_info - fall back to the journal probe
+            z = self._zone_with_versions(bucket, object_name)
+            if z is None:
+                raise
         return z.delete_object(bucket, object_name, version_id)
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
-                    metadata=None):
+                    metadata=None, versioned=False):
         import io
 
         src_zone = self._find_zone(src_bucket, src_object)
@@ -146,7 +176,8 @@ class ErasureZones(ObjectLayer):
             meta.update(metadata)
         meta.pop("etag", None)
         return self.put_object(
-            dst_bucket, dst_object, buf, info.size, meta
+            dst_bucket, dst_object, buf, info.size, meta,
+            versioned=versioned,
         )
 
     def heal_object(self, bucket, object_name, version_id="", dry_run=False):
@@ -177,6 +208,21 @@ class ErasureZones(ObjectLayer):
             for z in self.zones
         ]
         return merge_list_results(results, max_keys)
+
+    def list_object_versions(self, bucket, prefix="", key_marker="",
+                             version_id_marker="", delimiter="",
+                             max_keys=1000):
+        from .sets import merge_version_results
+
+        self.zones[0].get_bucket_info(bucket)
+        results = [
+            z.list_object_versions(
+                bucket, prefix, key_marker, version_id_marker,
+                delimiter, max_keys,
+            )
+            for z in self.zones
+        ]
+        return merge_version_results(results, max_keys)
 
     # -- multipart (pin the upload's zone at initiate time) ---------------
 
@@ -223,10 +269,10 @@ class ErasureZones(ObjectLayer):
         return z.abort_multipart_upload(bucket, object_name, uid)
 
     def complete_multipart_upload(self, bucket, object_name, upload_id,
-                                  parts):
+                                  parts, versioned=False):
         z, uid = self._upload_zone(upload_id)
         return z.complete_multipart_upload(
-            bucket, object_name, uid, parts
+            bucket, object_name, uid, parts, versioned
         )
 
     def storage_info(self) -> dict:

@@ -520,6 +520,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._respond(200, xmlr.location_xml(""))
             if "policy" in query:
                 return self._get_bucket_policy(bucket)
+            if "versions" in query:
+                return self._list_object_versions(bucket, query)
             if "uploads" in query:
                 return self._list_uploads(bucket, query)
             if "versioning" in query:
@@ -542,6 +544,10 @@ class _Handler(BaseHTTPRequestHandler):
         if m == "PUT":
             if "policy" in query:
                 return self._put_bucket_policy(bucket, self._read_body())
+            if "versioning" in query:
+                return self._put_bucket_versioning(
+                    bucket, self._read_body()
+                )
             ol.make_bucket(bucket)
             return self._respond(200, headers={"Location": f"/{bucket}"})
         if m == "DELETE":
@@ -612,6 +618,57 @@ class _Handler(BaseHTTPRequestHandler):
             )
         self._respond(200, body)
 
+    # -- versioning (bucket-versioning-handler.go) ------------------------
+
+    def _versioning(self, bucket: str) -> "tuple[bool, bool]":
+        """(versioned, suspended) for the bucket."""
+        try:
+            bm = self.s3.bucket_meta.get(bucket)
+        except Exception:  # noqa: BLE001
+            return False, False
+        return bm.versioning_enabled, bm.versioning_suspended
+
+    def _put_bucket_versioning(self, bucket: str, body: bytes):
+        self.s3.object_layer.get_bucket_info(bucket)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML") from None
+        ns = (
+            root.tag[: root.tag.index("}") + 1]
+            if root.tag.startswith("{")
+            else ""
+        )
+        status = (root.findtext(f"{ns}Status") or "").strip()
+        if status not in ("Enabled", "Suspended"):
+            raise S3Error("MalformedXML", "bad versioning Status")
+        self.s3.bucket_meta.update(bucket, versioning=status)
+        self._respond(200)
+
+    def _list_object_versions(self, bucket: str, query):
+        q1 = {k: v[0] for k, v in query.items()}
+        try:
+            max_keys = int(q1.get("max-keys", 1000))
+        except ValueError:
+            raise S3Error("InvalidArgument", "max-keys") from None
+        if max_keys < 0:
+            raise S3Error("InvalidArgument", "max-keys negative")
+        prefix = q1.get("prefix", "")
+        delimiter = q1.get("delimiter", "")
+        key_marker = q1.get("key-marker", "")
+        vid_marker = q1.get("version-id-marker", "")
+        encode = q1.get("encoding-type", "") == "url"
+        res = self.s3.object_layer.list_object_versions(
+            bucket, prefix, key_marker, vid_marker, delimiter, max_keys
+        )
+        self._respond(
+            200,
+            xmlr.list_versions_xml(
+                bucket, prefix, key_marker, vid_marker, delimiter,
+                max_keys, res, encode,
+            ),
+        )
+
     # -- bucket policy (PutBucketPolicyHandler, bucket-policy-handlers.go)
 
     def _get_bucket_policy(self, bucket: str):
@@ -646,22 +703,28 @@ class _Handler(BaseHTTPRequestHandler):
         quiet = (root.findtext(f"{ns}Quiet") or "").lower() == "true"
         deleted, errs = [], []
         account = self._auth.access_key if self._auth else ""
+        versioned, suspended = self._versioning(bucket)
         for obj in root.findall(f"{ns}Object"):
             key = obj.findtext(f"{ns}Key") or ""
+            vid = (obj.findtext(f"{ns}VersionId") or "").strip()
             # per-key authorization (DeleteMultipleObjectsHandler checks
             # DeleteObject for every named key)
-            if not self._check_action(
-                "s3:DeleteObject", bucket, key, account
-            ):
+            action = "s3:DeleteObjectVersion" if vid else "s3:DeleteObject"
+            if not self._check_action(action, bucket, key, account):
                 errs.append((key, "AccessDenied", "Access Denied."))
                 continue
             try:
-                self.s3.object_layer.delete_object(bucket, key)
+                # a named version is removed outright; an unqualified
+                # delete on a versioned bucket writes a marker
+                self.s3.object_layer.delete_object(
+                    bucket, key, vid,
+                    versioned=versioned, version_suspended=suspended,
+                )
                 if not quiet:
                     deleted.append(key)
             except Exception as e:  # noqa: BLE001
                 err = s3errors.from_exception(e)
-                if err.code == "NoSuchKey":
+                if err.code in ("NoSuchKey", "NoSuchVersion"):
                     if not quiet:
                         deleted.append(key)  # S3 treats as success
                 else:
@@ -874,10 +937,15 @@ class _Handler(BaseHTTPRequestHandler):
         if size > MAX_OBJECT_SIZE:
             raise S3Error("EntityTooLarge")
         hreader = self._hash_reader(reader, size)
+        versioned, _ = self._versioning(bucket)
         info = self.s3.object_layer.put_object(
-            bucket, key, hreader, size, self._collect_user_metadata()
+            bucket, key, hreader, size, self._collect_user_metadata(),
+            versioned=versioned,
         )
-        self._respond(200, b"", {"ETag": f'"{info.etag}"'})
+        hdrs = {"ETag": f'"{info.etag}"'}
+        if info.version_id:
+            hdrs["x-amz-version-id"] = info.version_id
+        self._respond(200, b"", hdrs)
 
     def _parse_copy_source(self) -> "tuple[str, str]":
         """(bucket, key) from x-amz-copy-source - one parser for both
@@ -899,22 +967,37 @@ class _Handler(BaseHTTPRequestHandler):
             if directive == "REPLACE"
             else None
         )
+        versioned, _ = self._versioning(bucket)
         info = self.s3.object_layer.copy_object(
-            src_bucket, src_key, bucket, key, meta
+            src_bucket, src_key, bucket, key, meta, versioned=versioned
+        )
+        hdrs = (
+            {"x-amz-version-id": info.version_id}
+            if info.version_id
+            else None
         )
         self._respond(
-            200, xmlr.copy_object_xml(info.etag, info.mod_time_ns)
+            200, xmlr.copy_object_xml(info.etag, info.mod_time_ns), hdrs
         )
 
     def _delete_object(self, bucket, key, query):
         version_id = query.get("versionId", [""])[0]
+        versioned, suspended = self._versioning(bucket)
+        hdrs: dict = {}
         try:
-            self.s3.object_layer.delete_object(bucket, key, version_id)
+            info = self.s3.object_layer.delete_object(
+                bucket, key, version_id,
+                versioned=versioned, version_suspended=suspended,
+            )
+            if info.delete_marker:
+                hdrs["x-amz-delete-marker"] = "true"
+            if info.version_id:
+                hdrs["x-amz-version-id"] = info.version_id
         except Exception as e:  # noqa: BLE001
             err = s3errors.from_exception(e)
             if err.code != "NoSuchKey":
                 raise
-        self._respond(204)
+        self._respond(204, b"", hdrs)
 
     # -- multipart --------------------------------------------------------
 
@@ -960,8 +1043,14 @@ class _Handler(BaseHTTPRequestHandler):
                     (pe.findtext(f"{ns}ETag") or "").strip('"'),
                 )
             )
+        versioned, _ = self._versioning(bucket)
         info = self.s3.object_layer.complete_multipart_upload(
-            bucket, key, uid, parts
+            bucket, key, uid, parts, versioned=versioned
+        )
+        hdrs = (
+            {"x-amz-version-id": info.version_id}
+            if info.version_id
+            else None
         )
         self._respond(
             200,
@@ -971,6 +1060,7 @@ class _Handler(BaseHTTPRequestHandler):
                 key,
                 info.etag,
             ),
+            hdrs,
         )
 
     def _abort_multipart(self, bucket, key, query):

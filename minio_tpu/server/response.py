@@ -210,3 +210,42 @@ def post_response_xml(location, bucket, key, etag) -> bytes:
     _el(root, "Key", key)
     _el(root, "ETag", f'"{etag}"')
     return _render(root)
+
+
+def list_versions_xml(
+    bucket, prefix, key_marker, version_id_marker, delimiter,
+    max_keys, res, encode: bool = False,
+) -> bytes:
+    """ListVersionsResult: Version + DeleteMarker entries
+    (generateListVersionsResponse, cmd/api-response.go)."""
+    root = ET.Element("ListVersionsResult", xmlns=S3_NS)
+    _el(root, "Name", bucket)
+    _el(root, "Prefix", _maybe_encode(prefix, encode))
+    _el(root, "KeyMarker", _maybe_encode(key_marker, encode))
+    if version_id_marker:
+        _el(root, "VersionIdMarker", version_id_marker)
+    if delimiter:
+        _el(root, "Delimiter", _maybe_encode(delimiter, encode))
+    _el(root, "MaxKeys", max_keys)
+    _el(root, "IsTruncated", "true" if res.is_truncated else "false")
+    if res.is_truncated:
+        _el(root, "NextKeyMarker", _maybe_encode(res.next_key_marker, encode))
+        _el(root, "NextVersionIdMarker", res.next_version_id_marker)
+    for o in res.versions:
+        tag = "DeleteMarker" if o.delete_marker else "Version"
+        ve = _el(root, tag)
+        _el(ve, "Key", _maybe_encode(o.name, encode))
+        _el(ve, "VersionId", o.version_id or "null")
+        _el(ve, "IsLatest", "true" if o.is_latest else "false")
+        _el(ve, "LastModified", _iso(o.mod_time_ns))
+        if not o.delete_marker:
+            _el(ve, "ETag", f'"{o.etag}"')
+            _el(ve, "Size", o.size)
+            _el(ve, "StorageClass", "STANDARD")
+        own = _el(ve, "Owner")
+        _el(own, "ID", "minio")
+        _el(own, "DisplayName", "minio")
+    for p in res.prefixes:
+        cp = _el(root, "CommonPrefixes")
+        _el(cp, "Prefix", _maybe_encode(p, encode))
+    return _render(root)

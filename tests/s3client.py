@@ -128,6 +128,11 @@ class S3Client:
     def delete_object(self, bucket, key):
         return self.request("DELETE", f"/{bucket}/{key}")
 
+    def delete_object_version(self, bucket, key, version_id):
+        return self.request(
+            "DELETE", f"/{bucket}/{key}", query={"versionId": version_id}
+        )
+
     def list_objects(self, bucket, **query):
         return self.request("GET", f"/{bucket}", query=query)
 

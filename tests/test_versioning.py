@@ -1,0 +1,347 @@
+"""Object versioning end-to-end (xl-storage-format-v2 version journal +
+bucket-versioning-handler.go semantics).
+
+Enable/suspend round-trip, version minting on PUT, delete markers,
+GET/DELETE ?versionId, ListObjectVersions, and the null-version
+interplay when versioning is suspended.
+"""
+
+import io
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+BLOCK = 4096
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("vdisks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = S3Client(server.endpoint)
+    c.make_bucket("vers")
+    return c
+
+
+VC_ENABLED = (
+    b'<VersioningConfiguration><Status>Enabled</Status>'
+    b"</VersioningConfiguration>"
+)
+VC_SUSPENDED = (
+    b'<VersioningConfiguration><Status>Suspended</Status>'
+    b"</VersioningConfiguration>"
+)
+
+
+def _enable(client, bucket="vers"):
+    r = client.request(
+        "PUT", f"/{bucket}", query={"versioning": ""}, body=VC_ENABLED
+    )
+    assert r.status == 200, r.body
+
+
+def test_versioning_config_roundtrip(client):
+    r = client.request("GET", "/vers", query={"versioning": ""})
+    assert r.status == 200
+    assert b"<Status>" not in r.body  # never configured
+    _enable(client)
+    r = client.request("GET", "/vers", query={"versioning": ""})
+    assert b"<Status>Enabled</Status>" in r.body
+    r = client.request(
+        "PUT", "/vers", query={"versioning": ""}, body=VC_SUSPENDED
+    )
+    assert r.status == 200
+    r = client.request("GET", "/vers", query={"versioning": ""})
+    assert b"<Status>Suspended</Status>" in r.body
+    _enable(client)  # leave enabled for later tests
+    # bad status rejected
+    r = client.request(
+        "PUT", "/vers", query={"versioning": ""},
+        body=b"<VersioningConfiguration><Status>Maybe</Status></VersioningConfiguration>",
+    )
+    assert r.status == 400
+
+
+def test_put_mints_versions_and_get_by_id(client):
+    _enable(client)
+    r1 = client.put_object("vers", "doc", b"version one")
+    v1 = r1.headers.get("x-amz-version-id")
+    assert v1
+    r2 = client.put_object("vers", "doc", b"version two")
+    v2 = r2.headers.get("x-amz-version-id")
+    assert v2 and v2 != v1
+    # latest wins
+    assert client.get_object("vers", "doc").body == b"version two"
+    # each version readable by id
+    r = client.get_object("vers", "doc", query={"versionId": v1})
+    assert r.status == 200 and r.body == b"version one"
+    assert r.headers.get("x-amz-version-id") == v1
+    r = client.get_object("vers", "doc", query={"versionId": v2})
+    assert r.body == b"version two"
+    # bogus version id
+    r = client.get_object(
+        "vers", "doc", query={"versionId": "00000000-dead-beef-0000-000000000000"}
+    )
+    assert r.status == 404
+
+
+def test_delete_marker_and_restore(client):
+    _enable(client)
+    client.put_object("vers", "ghost", b"alive")
+    r = client.delete_object("vers", "ghost")
+    assert r.status == 204
+    assert r.headers.get("x-amz-delete-marker") == "true"
+    marker_vid = r.headers.get("x-amz-version-id")
+    assert marker_vid
+    # object hidden now
+    assert client.get_object("vers", "ghost").status == 404
+    # deleting the marker by id restores the object
+    r = client.delete_object_version("vers", "ghost", marker_vid)
+    assert r.status == 204
+    assert client.get_object("vers", "ghost").body == b"alive"
+
+
+def test_delete_specific_version(client):
+    _enable(client)
+    v1 = client.put_object("vers", "multi", b"a").headers["x-amz-version-id"]
+    v2 = client.put_object("vers", "multi", b"bb").headers["x-amz-version-id"]
+    v3 = client.put_object("vers", "multi", b"ccc").headers["x-amz-version-id"]
+    # remove the middle version only
+    r = client.delete_object_version("vers", "multi", v2)
+    assert r.status == 204
+    assert client.get_object("vers", "multi").body == b"ccc"
+    assert (
+        client.get_object("vers", "multi", query={"versionId": v1}).body
+        == b"a"
+    )
+    assert (
+        client.get_object("vers", "multi", query={"versionId": v2}).status
+        == 404
+    )
+    # deleting the latest exposes the older one
+    r = client.delete_object_version("vers", "multi", v3)
+    assert r.status == 204
+    assert client.get_object("vers", "multi").body == b"a"
+
+
+def test_list_object_versions(client):
+    _enable(client)
+    vids = []
+    for i in range(3):
+        r = client.put_object("vers", "lv/key", f"data{i}".encode())
+        vids.append(r.headers["x-amz-version-id"])
+    client.delete_object("vers", "lv/key")  # adds a marker
+    r = client.request(
+        "GET", "/vers", query={"versions": "", "prefix": "lv/"}
+    )
+    assert r.status == 200
+    body = r.body.decode()
+    assert body.count("<Version>") == 3
+    assert body.count("<DeleteMarker>") == 1
+    # newest (the marker) is latest
+    assert body.index("<DeleteMarker>") < body.index("<Version>")
+    assert "<IsLatest>true</IsLatest>" in body
+    for v in vids:
+        assert v in body
+
+
+def test_list_versions_pagination(client):
+    _enable(client)
+    for i in range(5):
+        client.put_object("vers", "pg/obj", f"v{i}".encode())
+    seen = []
+    key_marker, vid_marker = "", ""
+    while True:
+        q = {"versions": "", "prefix": "pg/", "max-keys": "2"}
+        if key_marker:
+            q["key-marker"] = key_marker
+            q["version-id-marker"] = vid_marker
+        r = client.request("GET", "/vers", query=q)
+        assert r.status == 200
+        vids = r.xml_all("VersionId")
+        seen.extend(vids)
+        if r.xml_text("IsTruncated") != "true":
+            break
+        key_marker = r.xml_text("NextKeyMarker")
+        vid_marker = r.xml_text("NextVersionIdMarker")
+        assert key_marker
+    assert len(seen) == 5
+    assert len(set(seen)) == 5
+
+
+def test_suspended_writes_null_version(client):
+    _enable(client)
+    r = client.put_object("vers", "susp", b"real version")
+    real_vid = r.headers["x-amz-version-id"]
+    client.request(
+        "PUT", "/vers", query={"versioning": ""}, body=VC_SUSPENDED
+    )
+    r = client.put_object("vers", "susp", b"null one")
+    assert r.headers.get("x-amz-version-id") in (None, "null")
+    r = client.put_object("vers", "susp", b"null two")
+    # null version overwritten in place; real version intact
+    assert client.get_object("vers", "susp").body == b"null two"
+    assert (
+        client.get_object("vers", "susp", query={"versionId": real_vid}).body
+        == b"real version"
+    )
+    r = client.request(
+        "GET", "/vers", query={"versions": "", "prefix": "susp"}
+    )
+    body = r.body.decode()
+    assert body.count("<Version>") == 2  # null + real
+    assert "<VersionId>null</VersionId>" in body
+    # suspended DELETE writes a null delete marker, real version safe
+    r = client.delete_object("vers", "susp")
+    assert r.headers.get("x-amz-delete-marker") == "true"
+    assert client.get_object("vers", "susp").status == 404
+    assert (
+        client.get_object("vers", "susp", query={"versionId": real_vid}).body
+        == b"real version"
+    )
+    # null marker removable by versionId=null
+    r = client.delete_object_version("vers", "susp", "null")
+    assert r.status == 204
+    assert client.get_object("vers", "susp").body == b"real version"
+    _enable(client)
+
+
+def test_unversioned_bucket_unaffected(client, server):
+    c = client
+    c.make_bucket("plain")
+    r = c.put_object("plain", "obj", b"one")
+    assert "x-amz-version-id" not in r.headers
+    c.put_object("plain", "obj", b"two")
+    assert c.get_object("plain", "obj").body == b"two"
+    r = c.delete_object("plain", "obj")
+    assert "x-amz-delete-marker" not in r.headers
+    assert c.get_object("plain", "obj").status == 404
+    # overwrite reaped the old data dir: only xl.meta+data of latest,
+    # and after delete the object dir is gone entirely
+    ol = server.object_layer
+    for d in ol.disks:
+        assert not list(d.walk("plain"))
+
+
+def test_multipart_versioned_complete(client):
+    _enable(client)
+    r = client.request("POST", "/vers/mp-v", query={"uploads": ""})
+    uid = r.xml_text("UploadId")
+    data = b"p" * (6 << 20)
+    r = client.request(
+        "PUT", "/vers/mp-v",
+        query={"partNumber": "1", "uploadId": uid}, body=data,
+    )
+    etag = r.headers["etag"]
+    body = (
+        f'<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>'
+        f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>"
+    ).encode()
+    r = client.request(
+        "POST", "/vers/mp-v", query={"uploadId": uid}, body=body
+    )
+    assert r.status == 200
+    vid = r.headers.get("x-amz-version-id")
+    assert vid
+    assert client.get_object("vers", "mp-v").body == data
+    # overwrite then read the multipart version by id
+    client.put_object("vers", "mp-v", b"tiny")
+    assert (
+        client.get_object("vers", "mp-v", query={"versionId": vid}).body
+        == data
+    )
+
+
+def test_copy_into_versioned_bucket(client):
+    _enable(client)
+    client.put_object("vers", "cp-src", b"copy me")
+    r = client.request(
+        "PUT", "/vers/cp-dst",
+        headers={"x-amz-copy-source": "/vers/cp-src"},
+    )
+    assert r.status == 200
+    assert r.headers.get("x-amz-version-id")
+
+
+def test_multi_delete_with_version_ids(client):
+    """?delete entries naming a VersionId remove that exact version
+    rather than minting a marker (review finding)."""
+    _enable(client)
+    v1 = client.put_object("vers", "mdv", b"a").headers["x-amz-version-id"]
+    v2 = client.put_object("vers", "mdv", b"b").headers["x-amz-version-id"]
+    body = (
+        f"<Delete><Object><Key>mdv</Key><VersionId>{v1}</VersionId>"
+        f"</Object></Delete>"
+    ).encode()
+    r = client.request("POST", "/vers", query={"delete": ""}, body=body)
+    assert r.status == 200 and b"AccessDenied" not in r.body
+    # v1 gone, v2 intact, no new marker
+    assert (
+        client.get_object("vers", "mdv", query={"versionId": v1}).status
+        == 404
+    )
+    assert client.get_object("vers", "mdv").body == b"b"
+    lr = client.request(
+        "GET", "/vers", query={"versions": "", "prefix": "mdv"}
+    )
+    assert lr.body.count(b"<DeleteMarker>") == 0
+    # deleting a nonexistent version is success (S3 semantics)
+    r = client.request("POST", "/vers", query={"delete": ""}, body=body)
+    assert r.status == 200 and b"<Error>" not in r.body
+
+
+def test_list_versions_negative_max_keys(client):
+    r = client.request(
+        "GET", "/vers", query={"versions": "", "max-keys": "-1"}
+    )
+    assert r.status == 400
+
+
+def test_merge_respects_truncated_input_boundary():
+    """A truncated per-set result bounds the merged page so no keys
+    are skipped on resume (review finding)."""
+    from minio_tpu.objectlayer.api import ListObjectsInfo, ObjectInfo
+    from minio_tpu.objectlayer.sets import (
+        merge_list_results,
+        merge_version_results,
+    )
+
+    def oi(name):
+        return ObjectInfo(bucket="b", name=name, mod_time_ns=1)
+
+    # set A truncated at a1 (a2+ unreturned); set B has z
+    ra = ListObjectsInfo(
+        objects=[oi("a0"), oi("a1")], is_truncated=True, next_marker="a1"
+    )
+    rb = ListObjectsInfo(objects=[oi("z")])
+    merged = merge_list_results([ra, rb], 1000)
+    names = [o.name for o in merged.objects]
+    assert "z" not in names  # past the boundary
+    assert merged.is_truncated
+    assert merged.next_marker == "a1"
+
+    from minio_tpu.objectlayer.api import ListObjectVersionsInfo
+
+    va = ListObjectVersionsInfo(
+        versions=[oi("a0"), oi("a1")],
+        is_truncated=True,
+        next_key_marker="a1",
+        next_version_id_marker="null",
+    )
+    vb = ListObjectVersionsInfo(versions=[oi("z")])
+    vm = merge_version_results([va, vb], 1000)
+    assert all(o.name <= "a1" for o in vm.versions)
+    assert vm.is_truncated and vm.next_key_marker == "a1"
