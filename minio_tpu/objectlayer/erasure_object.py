@@ -598,27 +598,69 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     # list (merged walk; cmd/erasure-sets.go listing semantics simplified)
     # ------------------------------------------------------------------
 
-    def list_objects(
-        self, bucket, prefix="", marker="", delimiter="", max_keys=1000,
-    ) -> ListObjectsInfo:
-        self._require_bucket(bucket)
-        max_keys = max(0, min(max_keys, 1000))
-        names: set[str] = set()
+    def _merged_walk(
+        self, bucket, prefix, marker, recursive, inclusive=False
+    ):
+        """K-way lazy merge of the per-disk ordered walks, deduplicated
+        by name (lexicallySortedEntry, erasure-sets.go:842) - nothing is
+        materialized; a page pulls only what it emits."""
+        import heapq
+
+        def safe(gen):
+            # one bad disk ends its stream, not the listing
+            while True:
+                try:
+                    yield next(gen)
+                except StopIteration:
+                    return
+                except Exception:  # noqa: BLE001
+                    return
+
+        its = []
         for d in self._online_disks():
             if d is None:
                 continue
             try:
-                names.update(d.walk(bucket))
+                its.append(
+                    safe(
+                        d.walk_sorted(
+                            bucket, prefix, marker,
+                            recursive=recursive, inclusive=inclusive,
+                        )
+                    )
+                )
             except Exception:  # noqa: BLE001
                 continue
-        out = ListObjectsInfo()
+        last = None
+        for name, is_prefix in heapq.merge(*its):
+            if name == last:
+                continue
+            last = name
+            yield name, is_prefix
+
+    def _list_entries(
+        self, bucket, prefix, marker, delimiter, inclusive=False
+    ):
+        """Shared listing front half: merged walk filtered down to
+        ("prefix", name) / ("key", name) entries in lexical order, with
+        delimiter folding.  Pagination/truncation stays with callers
+        (they differ: one entry per key vs one per version)."""
+        # delimiter "/" maps onto single-level directory reads; other
+        # delimiters need the full recursive stream (tree-walk.go)
+        recursive = delimiter != "/"
         seen_prefixes: set[str] = set()
-        count = 0
-        last_key = ""
-        for name in sorted(names):
+        for name, is_prefix in self._merged_walk(
+            bucket, prefix, marker, recursive, inclusive=inclusive
+        ):
+            if is_prefix:
+                if name <= marker:
+                    continue
+                yield "prefix", name
+                continue
             if prefix and not name.startswith(prefix):
                 continue
-            if delimiter:
+            if delimiter and recursive:
+                # non-"/" delimiter: fold names into common prefixes
                 rest = name[len(prefix):]
                 di = rest.find(delimiter)
                 if di >= 0:
@@ -626,21 +668,33 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     if cp <= marker:
                         continue
                     if cp not in seen_prefixes:
-                        if count >= max_keys:
-                            out.is_truncated = True
-                            out.next_marker = last_key
-                            break
                         seen_prefixes.add(cp)
-                        out.prefixes.append(cp)
-                        count += 1
-                        last_key = cp
+                        yield "prefix", cp
                     continue
-            if marker and name <= marker:
+            if marker and (name < marker or (name == marker and not inclusive)):
                 continue
+            yield "key", name
+
+    def list_objects(
+        self, bucket, prefix="", marker="", delimiter="", max_keys=1000,
+    ) -> ListObjectsInfo:
+        self._require_bucket(bucket)
+        max_keys = max(0, min(max_keys, 1000))
+        out = ListObjectsInfo()
+        count = 0
+        last_key = ""
+        for kind, name in self._list_entries(
+            bucket, prefix, marker, delimiter
+        ):
             if count >= max_keys:
                 out.is_truncated = True
                 out.next_marker = last_key
                 break
+            if kind == "prefix":
+                out.prefixes.append(name)
+                count += 1
+                last_key = name
+                continue
             try:
                 fi, _ = self._read_quorum_fileinfo(bucket, name)
             except Exception:  # noqa: BLE001
@@ -695,40 +749,22 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     ) -> api.ListObjectVersionsInfo:
         self._require_bucket(bucket)
         max_keys = max(0, min(max_keys, 1000))
-        names: set[str] = set()
-        for d in self._online_disks():
-            if d is None:
-                continue
-            try:
-                names.update(d.walk(bucket))
-            except Exception:  # noqa: BLE001
-                continue
         out = api.ListObjectVersionsInfo()
-        seen_prefixes: set[str] = set()
         count = 0
         last = (key_marker, version_id_marker)  # last emitted (key, vid)
-        for name in sorted(names):
-            if prefix and not name.startswith(prefix):
-                continue
-            if delimiter:
-                rest = name[len(prefix):]
-                di = rest.find(delimiter)
-                if di >= 0:
-                    cp = prefix + rest[: di + len(delimiter)]
-                    if cp <= key_marker:
-                        continue
-                    if cp not in seen_prefixes:
-                        if count >= max_keys:
-                            out.is_truncated = True
-                            out.next_key_marker = last[0]
-                            out.next_version_id_marker = last[1]
-                            return out
-                        seen_prefixes.add(cp)
-                        out.prefixes.append(cp)
-                        count += 1
-                        last = (cp, "")
-                    continue
-            if key_marker and name < key_marker:
+        # the marker key itself is re-visited (version resume)
+        for kind, name in self._list_entries(
+            bucket, prefix, key_marker, delimiter, inclusive=True
+        ):
+            if kind == "prefix":
+                if count >= max_keys:
+                    out.is_truncated = True
+                    out.next_key_marker = last[0]
+                    out.next_version_id_marker = last[1]
+                    return out
+                out.prefixes.append(name)
+                count += 1
+                last = (name, "")
                 continue
             versions = self._read_version_journal(bucket, name)
             resumed = False

@@ -995,7 +995,9 @@ class _Handler(BaseHTTPRequestHandler):
                 hdrs["x-amz-version-id"] = info.version_id
         except Exception as e:  # noqa: BLE001
             err = s3errors.from_exception(e)
-            if err.code != "NoSuchKey":
+            # deleting what is already gone is success (idempotent, and
+            # consistent with the multi-delete path)
+            if err.code not in ("NoSuchKey", "NoSuchVersion"):
                 raise
         self._respond(204, b"", hdrs)
 

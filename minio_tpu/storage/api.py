@@ -137,6 +137,18 @@ class StorageAPI:
         truncated back to it before writing)."""
         raise NotImplementedError
 
+    def walk_sorted(
+        self,
+        volume: str,
+        prefix: str = "",
+        marker: str = "",
+        recursive: bool = True,
+        inclusive: bool = False,
+    ):
+        """Yield (name, is_prefix) lazily in lexical order, pruning
+        subtrees outside prefix/after marker (tree-walk.go)."""
+        raise NotImplementedError
+
     def read_file_stream(self, volume: str, path: str) -> ShardReader:
         raise NotImplementedError
 

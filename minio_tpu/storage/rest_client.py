@@ -376,3 +376,35 @@ class StorageRESTClient(StorageAPI):
         yield from wire.unpack(
             self._call("walk", {"vol": volume, "path": prefix})
         )
+
+    def walk_sorted(
+        self,
+        volume: str,
+        prefix: str = "",
+        marker: str = "",
+        recursive: bool = True,
+        inclusive: bool = False,
+        batch: int = 1000,
+    ):
+        """Ordered walk over the wire: bounded batches, marker-advanced
+        continuation (the remote half of tree-walk)."""
+        while True:
+            rows = wire.unpack(
+                self._call(
+                    "walksorted",
+                    {
+                        "vol": volume,
+                        "prefix": prefix,
+                        "marker": marker,
+                        "recursive": "1" if recursive else "0",
+                        "inclusive": "1" if inclusive else "0",
+                        "count": str(batch),
+                    },
+                )
+            )
+            for name, is_prefix in rows:
+                yield (name, is_prefix)
+            if len(rows) < batch:
+                return
+            marker = rows[-1][0]
+            inclusive = False  # continuation is strictly after marker

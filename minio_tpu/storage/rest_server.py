@@ -170,4 +170,21 @@ class StorageRESTServer:
             return b""
         if m == "walk":
             return wire.pack(list(disk.walk(vol, path)))
+        if m == "walksorted":
+            # bounded batch of the ordered walk; the client re-requests
+            # with an advanced marker (tree-walk continuation)
+            count = int(q.get("count", 1000))
+            out = []
+            it = disk.walk_sorted(
+                vol,
+                q.get("prefix", ""),
+                q.get("marker", ""),
+                recursive=q.get("recursive", "1") == "1",
+                inclusive=q.get("inclusive") == "1",
+            )
+            for name, is_prefix in it:
+                out.append([name, is_prefix])
+                if len(out) >= count:
+                    break
+            return wire.pack(out)
         raise ValueError(f"unknown storage method {m!r}")

@@ -429,6 +429,93 @@ class XLStorage(StorageAPI):
                 dirnames[:] = []  # don't descend into data dirs
                 yield rel
 
+    # ---- ordered bounded walk (tree-walk.go analogue) -------------------
+
+    def walk_sorted(
+        self,
+        volume: str,
+        prefix: str = "",
+        marker: str = "",
+        recursive: bool = True,
+        inclusive: bool = False,
+    ):
+        """Yield ``(name, is_prefix)`` in lexical order, lazily.
+
+        The scalable-listing primitive (cmd/tree-walk.go doTreeWalk):
+        directories are read in sorted order and subtrees that cannot
+        contain a name matching ``prefix`` and > ``marker`` are pruned,
+        so one page of results touches only the directories it needs.
+        ``recursive=False`` lists a single level (delimiter="/" mode):
+        plain directories come back once as ("dir/", True) without
+        descending.  ``inclusive`` keeps names equal to the marker
+        (version listings re-visit the marker key).
+        """
+        vol = self._require_vol(volume)
+        if recursive:
+            yield from self._walk_rec(vol, "", prefix, marker, inclusive)
+            return
+        base, _, leaf = prefix.rpartition("/")
+        base_fs = (
+            os.path.join(vol, *base.split("/")) if base else vol
+        )
+        if base and os.path.isfile(os.path.join(base_fs, XL_META)):
+            # the prefix points INSIDE an object's directory: its
+            # children are erasure data dirs, not namespace entries -
+            # leaking them as CommonPrefixes exposes internal layout
+            return
+        try:
+            entries = sorted(os.listdir(base_fs))
+        except (FileNotFoundError, NotADirectoryError):
+            return
+        basep = base + "/" if base else ""
+        for e in entries:
+            if leaf and not e.startswith(leaf):
+                continue
+            full = os.path.join(base_fs, e)
+            if not os.path.isdir(full):
+                continue
+            if os.path.isfile(os.path.join(full, XL_META)):
+                name = basep + e
+                if name > marker or (inclusive and name == marker):
+                    yield (name, False)
+            else:
+                cp = basep + e + "/"
+                if cp > marker:
+                    yield (cp, True)
+
+    def _walk_rec(self, vol, rel, prefix, marker, inclusive):
+        base = os.path.join(vol, *rel.split("/")) if rel else vol
+        try:
+            entries = sorted(os.listdir(base))
+        except (FileNotFoundError, NotADirectoryError):
+            return
+        for e in entries:
+            name = f"{rel}/{e}" if rel else e
+            full = os.path.join(base, e)
+            if not os.path.isdir(full):
+                continue
+            if os.path.isfile(os.path.join(full, XL_META)):
+                if prefix and not name.startswith(prefix):
+                    continue
+                if name > marker or (inclusive and name == marker):
+                    yield (name, False)
+                continue  # object dirs hold data dirs, not children
+            sub = name + "/"
+            # prefix prune: the subtree's names all start with `sub`
+            if prefix and not (
+                sub.startswith(prefix) or prefix.startswith(sub)
+            ):
+                continue
+            # marker prune: every name under `sub` is < marker exactly
+            # when marker doesn't extend `sub` and sorts after it
+            if (
+                marker
+                and not marker.startswith(sub)
+                and sub < marker
+            ):
+                continue
+            yield from self._walk_rec(vol, name, prefix, marker, inclusive)
+
     # ---- staging helpers (object-layer use) -----------------------------
 
     def new_tmp_dir(self) -> str:

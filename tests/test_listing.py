@@ -1,0 +1,186 @@
+"""Scalable listing: ordered pruned tree-walk + streaming merge
+(cmd/tree-walk.go, erasure-sets.go:842 lexical merge).
+
+Asserts not just correctness of paging but BOUNDEDNESS: one page must
+not enumerate or stat the whole namespace (the VERDICT r2 finding was
+O(total objects x disks) per page request).
+"""
+
+import io
+import os
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.storage.xl import XLStorage
+
+BLOCK = 4096
+
+
+class CountingDisk(XLStorage):
+    """XLStorage that counts listdir-equivalent and metadata reads."""
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.listdir_calls = 0
+        self.read_xl_calls = 0
+        self.read_version_calls = 0
+
+    def walk_sorted(self, *a, **kw):
+        it = super().walk_sorted(*a, **kw)
+        for row in it:
+            yield row
+
+    def _walk_rec(self, vol, rel, prefix, marker, inclusive):
+        self.listdir_calls += 1
+        yield from super()._walk_rec(vol, rel, prefix, marker, inclusive)
+
+    def read_xl(self, volume, path):
+        self.read_xl_calls += 1
+        return super().read_xl(volume, path)
+
+    def read_version(self, volume, path, version_id=""):
+        self.read_version_calls += 1
+        return super().read_version(volume, path, version_id)
+
+    def reset(self):
+        self.listdir_calls = 0
+        self.read_xl_calls = 0
+        self.read_version_calls = 0
+
+
+@pytest.fixture(scope="module")
+def big_layer(tmp_path_factory):
+    root = tmp_path_factory.mktemp("bigns")
+    disks = [CountingDisk(str(root / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK)
+    ol.make_bucket("big")
+    # 50 folders x 40 objects = 2000 keys; folder layout exercises the
+    # subtree pruning
+    for f in range(50):
+        for o in range(40):
+            ol.put_object(
+                "big", f"f{f:03d}/o{o:03d}", io.BytesIO(b"x"), 1
+            )
+    return ol, disks
+
+
+def test_paged_listing_correct_and_bounded(big_layer):
+    ol, disks = big_layer
+    for d in disks:
+        d.reset()
+    seen = []
+    marker = ""
+    pages = 0
+    while True:
+        res = ol.list_objects("big", "", marker, "", 200)
+        seen.extend(o.name for o in res.objects)
+        pages += 1
+        if not res.is_truncated:
+            break
+        marker = res.next_marker
+    assert len(seen) == 2000
+    assert seen == sorted(seen)
+    assert pages == 10
+    # boundedness: metadata reads = one quorum read per emitted object,
+    # not per page-scan of the namespace
+    per_disk_reads = max(d.read_version_calls for d in disks)
+    assert per_disk_reads <= 2000 + pages
+    # each 200-key page must NOT re-walk all 50 folders: across the
+    # whole run the directory reads stay near one sweep of the tree,
+    # not pages x folders
+    total_listdirs = sum(d.listdir_calls for d in disks)
+    # one full sweep = 51 dirs/disk = 204; allow the per-page re-descent
+    # down the marker path (~2 dirs/page/disk)
+    assert total_listdirs <= 4 * (51 + 3 * pages), total_listdirs
+
+
+def test_single_page_touches_one_subtree(big_layer):
+    """A prefix-scoped page must prune everything outside the prefix."""
+    ol, disks = big_layer
+    for d in disks:
+        d.reset()
+    res = ol.list_objects("big", "f007/", "", "", 1000)
+    assert len(res.objects) == 40
+    # pruning: only the root dir + the one folder dir are read per disk
+    assert max(d.listdir_calls for d in disks) <= 3
+    assert max(d.read_version_calls for d in disks) <= 41
+
+
+def test_delimiter_listing_does_not_descend(big_layer):
+    """delimiter=/ lists folders WITHOUT walking inside them."""
+    ol, disks = big_layer
+    for d in disks:
+        d.reset()
+    res = ol.list_objects("big", "", "", "/", 1000)
+    assert len(res.prefixes) == 50
+    assert not res.objects
+    # single-level read: no metadata reads, one listdir per disk
+    assert max(d.read_version_calls for d in disks) == 0
+    assert max(d.read_xl_calls for d in disks) == 0
+
+
+def test_walk_sorted_marker_pruning(tmp_path):
+    d = CountingDisk(str(tmp_path / "wd"))
+    d.make_vol("wv")
+    for name in ["a/1", "a/2", "b/1", "c/1", "c/2"]:
+        d.write_all("wv", f"{name}/xl.meta", b"XLT1")
+    d.reset()
+    # marker beyond 'a/': the 'a' subtree must be pruned entirely
+    names = [n for n, _ in d.walk_sorted("wv", "", "b/0")]
+    assert names == ["b/1", "c/1", "c/2"]
+    # root + b + c, but NOT a
+    assert d.listdir_calls == 3
+
+    # inclusive marker re-yields the marker itself
+    names = [n for n, _ in d.walk_sorted("wv", "", "b/1", inclusive=True)]
+    assert names == ["b/1", "c/1", "c/2"]
+
+    # prefix pruning
+    d.reset()
+    names = [n for n, _ in d.walk_sorted("wv", "c/")]
+    assert names == ["c/1", "c/2"]
+    assert d.listdir_calls == 2  # root + c only
+
+
+def test_remote_walk_sorted_batches(tmp_path):
+    """The REST walk streams in marker-advanced batches."""
+    from minio_tpu.server.http import S3Server
+    from minio_tpu.storage.rest_client import StorageRESTClient
+    from minio_tpu.storage.rest_common import PREFIX as STORAGE_PREFIX
+    from minio_tpu.storage.rest_server import StorageRESTServer
+
+    root = str(tmp_path / "rw")
+    local = XLStorage(root)
+    local.make_vol("rv")
+    for i in range(25):
+        local.write_all("rv", f"k{i:03d}/xl.meta", b"XLT1")
+    srv = S3Server(None, address="127.0.0.1:0", secret_key="sec")
+    srv.register_internode(
+        STORAGE_PREFIX, StorageRESTServer([local], "sec").handle
+    )
+    srv.start()
+    try:
+        rc = StorageRESTClient("127.0.0.1", srv.port, root, "sec")
+        names = [
+            n for n, _ in rc.walk_sorted("rv", batch=10)
+        ]
+        assert names == [f"k{i:03d}" for i in range(25)]
+        # marker resume mid-stream
+        names = [n for n, _ in rc.walk_sorted("rv", marker="k020", batch=10)]
+        assert names == [f"k{i:03d}" for i in range(21, 25)]
+    finally:
+        srv.shutdown()
+
+
+def test_prefix_inside_object_dir_leaks_nothing(tmp_path):
+    """Listing with a prefix pointing inside an object directory must
+    not surface erasure data-dir UUIDs (review finding)."""
+    d = XLStorage(str(tmp_path / "leak"))
+    d.make_vol("lv")
+    d.write_all("lv", "report/xl.meta", b"XLT1")
+    d.write_all("lv", "report/3a370c69aaaa/part.1", b"shard")
+    rows = list(d.walk_sorted("lv", "report/", "", recursive=False))
+    assert rows == []
+    rows = list(d.walk_sorted("lv", "report/", "", recursive=True))
+    assert rows == []
