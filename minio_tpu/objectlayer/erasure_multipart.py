@@ -145,6 +145,14 @@ class MultipartMixin:
         try:
             total = er.encode(hreader, writers, self.write_quorum)
         except QuorumError as e:
+            # close writers FIRST: streaming remote writers own sender
+            # threads that must terminate before staging is reaped
+            for w in writers:
+                if w is not None:
+                    try:
+                        w.close()
+                    except Exception:  # noqa: BLE001
+                        pass
             self._cleanup_tmp(disks, tmp_ids)
             raise WriteQuorumError(str(e)) from e
         for w in writers:

@@ -120,8 +120,6 @@ class ErasureSets(ObjectLayer):
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
                     metadata=None, versioned=False):
-        import io
-
         src_set = self.set_for(src_object)
         dst_set = self.set_for(dst_object)
         if src_set is dst_set:
@@ -129,17 +127,19 @@ class ErasureSets(ObjectLayer):
                 src_bucket, src_object, dst_bucket, dst_object, metadata,
                 versioned,
             )
+        from ..utils.pipe import streaming_copy
+
         info = src_set.get_object_info(src_bucket, src_object)
-        buf = io.BytesIO()
-        src_set.get_object(src_bucket, src_object, buf)
-        buf.seek(0)
         meta = dict(info.user_defined)
         if metadata:
             meta.update(metadata)
         meta.pop("etag", None)
-        return dst_set.put_object(
-            dst_bucket, dst_object, buf, info.size, meta,
-            versioned=versioned,
+        return streaming_copy(
+            lambda sink: src_set.get_object(src_bucket, src_object, sink),
+            lambda source: dst_set.put_object(
+                dst_bucket, dst_object, source, info.size, meta,
+                versioned=versioned,
+            ),
         )
 
     def heal_object(self, bucket, object_name, version_id="", dry_run=False):

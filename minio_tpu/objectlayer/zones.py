@@ -164,20 +164,27 @@ class ErasureZones(ObjectLayer):
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
                     metadata=None, versioned=False):
-        import io
+        from ..utils.pipe import streaming_copy
 
         src_zone = self._find_zone(src_bucket, src_object)
+        if src_bucket == dst_bucket and src_object == dst_object:
+            # self-copy: delegate down to the set, whose sequential
+            # path avoids the namespace-lock deadlock
+            return src_zone.copy_object(
+                src_bucket, src_object, dst_bucket, dst_object,
+                metadata, versioned,
+            )
         info = src_zone.get_object_info(src_bucket, src_object)
-        buf = io.BytesIO()
-        src_zone.get_object(src_bucket, src_object, buf)
-        buf.seek(0)
         meta = dict(info.user_defined)
         if metadata:
             meta.update(metadata)
         meta.pop("etag", None)
-        return self.put_object(
-            dst_bucket, dst_object, buf, info.size, meta,
-            versioned=versioned,
+        return streaming_copy(
+            lambda sink: src_zone.get_object(src_bucket, src_object, sink),
+            lambda source: self.put_object(
+                dst_bucket, dst_object, source, info.size, meta,
+                versioned=versioned,
+            ),
         )
 
     def heal_object(self, bucket, object_name, version_id="", dry_run=False):

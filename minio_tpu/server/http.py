@@ -49,6 +49,64 @@ MAX_INTERNODE_BODY = 64 << 20
 MAX_MULTI_DELETE_BODY = 1 << 20
 
 
+class _ChunkedReader:
+    """Decode a chunked transfer-encoded body from the socket.
+
+    The stdlib server leaves chunked TE undecoded; the internode shard
+    plane uses it so CreateFile bodies stream end-to-end without either
+    side buffering a whole shard (storage-rest-server.go CreateFile).
+    """
+
+    MAX_CHUNK = 16 << 20
+
+    def __init__(self, raw):
+        self._raw = raw
+        self._remaining = 0
+        self._done = False
+
+    def _read_line(self) -> bytes:
+        line = self._raw.readline(1024)
+        if not line.endswith(b"\r\n"):
+            raise OSError("bad chunk framing")
+        return line[:-2]
+
+    def read(self, n: int = -1) -> bytes:
+        out = bytearray()
+        while not self._done and (n < 0 or len(out) < n):
+            if self._remaining == 0:
+                size_s = self._read_line().split(b";")[0]
+                try:
+                    size = int(size_s, 16)
+                except ValueError:
+                    raise OSError("bad chunk size") from None
+                if size > self.MAX_CHUNK:
+                    raise OSError("chunk too large")
+                if size == 0:
+                    # consume optional trailers until the blank line
+                    while self._read_line():
+                        pass
+                    self._done = True
+                    break
+                self._remaining = size
+            want = self._remaining if n < 0 else min(
+                self._remaining, n - len(out)
+            )
+            chunk = self._raw.read(want)
+            if not chunk:
+                raise OSError("truncated chunked body")
+            out += chunk
+            self._remaining -= len(chunk)
+            if self._remaining == 0:
+                if self._raw.read(2) != b"\r\n":
+                    raise OSError("missing chunk CRLF")
+        return bytes(out)
+
+    def drain(self) -> None:
+        while not self._done:
+            if not self.read(1 << 20):
+                break
+
+
 class _LimitedReader:
     """Reads at most ``limit`` bytes from the underlying socket file."""
 
@@ -458,6 +516,32 @@ class _Handler(BaseHTTPRequestHandler):
                         401, b"unauthorized", content_type="text/plain"
                     )
                     return
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if te == "chunked":
+                # streaming shard plane: hand the decoded stream to the
+                # plane handler - nothing buffers the whole body
+                plane = getattr(handler, "__self__", None)
+                stream_fn = getattr(plane, "handle_stream", None)
+                if stream_fn is None:
+                    self.close_connection = True
+                    self._respond(
+                        411, b"length required", content_type="text/plain"
+                    )
+                    return
+                reader = _ChunkedReader(self.rfile)
+                status, payload, extra = stream_fn(
+                    method_tail, query, reader,
+                    dict(self.headers.items()),
+                )
+                try:
+                    reader.drain()  # keep-alive hygiene
+                except OSError:
+                    self.close_connection = True
+                self._respond(
+                    status, payload, extra,
+                    content_type="application/octet-stream",
+                )
+                return
             body = self.rfile.read(length) if length else b""
             status, payload, extra = handler(
                 method_tail, query, body, dict(self.headers.items())
@@ -962,6 +1046,13 @@ class _Handler(BaseHTTPRequestHandler):
         directive = self.headers.get(
             "x-amz-metadata-directive", "COPY"
         )
+        if (src_bucket, src_key) == (bucket, key) and directive != "REPLACE":
+            # S3: copying onto itself without changing metadata is
+            # rejected (CopyObjectHandler)
+            raise S3Error(
+                "InvalidRequest",
+                "self-copy requires x-amz-metadata-directive: REPLACE",
+            )
         meta = (
             self._collect_user_metadata()
             if directive == "REPLACE"

@@ -29,6 +29,29 @@ class StorageRESTServer:
             raise jwt.JWTError("missing bearer token")
         jwt.verify(authz[len("Bearer "):], self._secret)
 
+    def _preamble(self, query: dict, headers: "dict | None"):
+        """Shared auth + disk-lookup front half for both dispatch
+        paths.  Returns (disk, q, error_response_or_None)."""
+        try:
+            self.authenticate(
+                {k.lower(): v for k, v in (headers or {}).items()}
+            )
+        except Exception as e:  # noqa: BLE001
+            name, msg = wire.encode_error(e)
+            return None, {}, (
+                401, wire.pack({"error": name, "message": msg}), {}
+            )
+        q = {k: v[0] for k, v in query.items()}
+        disk = self._disks.get(q.get("disk", ""))
+        if disk is None:
+            from .errors import DiskNotFound
+
+            name, msg = wire.encode_error(DiskNotFound(q.get("disk", "")))
+            return None, q, (
+                400, wire.pack({"error": name, "message": msg}), {}
+            )
+        return disk, q, None
+
     def handle(
         self,
         method_name: str,
@@ -41,26 +64,46 @@ class StorageRESTServer:
         Authentication happens HERE, on the dispatch path, so no wiring
         can mount the storage plane unauthenticated (advisor finding r1).
         """
-        try:
-            self.authenticate(
-                {k.lower(): v for k, v in (headers or {}).items()}
-            )
-        except Exception as e:  # noqa: BLE001
-            name, msg = wire.encode_error(e)
-            return 401, wire.pack({"error": name, "message": msg}), {}
-        q = {k: v[0] for k, v in query.items()}
-        disk = self._disks.get(q.get("disk", ""))
-        if disk is None:
-            name, msg = wire.encode_error(
-                __import__(
-                    "minio_tpu.storage.errors", fromlist=["errors"]
-                ).DiskNotFound(q.get("disk", ""))
-            )
-            return 400, wire.pack({"error": name, "message": msg}), {}
+        disk, q, err = self._preamble(query, headers)
+        if err is not None:
+            return err
         try:
             out = self._dispatch(disk, method_name, q, body)
             return 200, out, {}
         except Exception as e:  # noqa: BLE001 - typed envelope
+            name, msg = wire.encode_error(e)
+            return 400, wire.pack({"error": name, "message": msg}), {}
+
+    def handle_stream(
+        self,
+        method_name: str,
+        query: dict,
+        reader,
+        headers: "dict | None" = None,
+    ) -> tuple[int, bytes, dict]:
+        """Streaming-body dispatch (chunked TE): CreateFile shard bytes
+        flow straight from the socket into the disk writer in bounded
+        chunks - neither side holds a whole shard
+        (storage-rest-server.go CreateFileHandler)."""
+        disk, q, err = self._preamble(query, headers)
+        if err is not None:
+            return err
+        if method_name != "createfile":
+            return 400, wire.pack(
+                {"error": "ValueError", "message": "not streamable"}
+            ), {}
+        try:
+            w = disk.create_file(q.get("vol", ""), q.get("path", ""))
+            try:
+                while True:
+                    chunk = reader.read(1 << 20)
+                    if not chunk:
+                        break
+                    w.write(chunk)
+            finally:
+                w.close()
+            return 200, b"", {}
+        except Exception as e:  # noqa: BLE001
             name, msg = wire.encode_error(e)
             return 400, wire.pack({"error": name, "message": msg}), {}
 

@@ -1,0 +1,226 @@
+"""Streaming data-plane tests: bounded-memory copy_object and the
+chunked internode CreateFile stream (storage-rest CreateFile,
+cmd/erasure-object.go CopyObject pipelining).
+"""
+
+import io
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.rest_client import StorageRESTClient
+from minio_tpu.storage.rest_common import PREFIX as STORAGE_PREFIX
+from minio_tpu.storage.rest_server import StorageRESTServer
+from minio_tpu.storage.xl import XLStorage
+from minio_tpu.utils.pipe import StreamPipe, streaming_copy
+
+BLOCK = 1 << 20  # 1 MiB blocks so a 32 MiB object is many blocks
+
+
+def _payload(size, seed=0):
+    return (
+        np.random.default_rng(seed)
+        .integers(0, 256, size, dtype=np.uint8)
+        .tobytes()
+    )
+
+
+# -- StreamPipe unit tests -------------------------------------------------
+
+
+def test_pipe_roundtrip():
+    pipe = StreamPipe()
+    data = _payload(3 << 20, seed=1)
+
+    import threading
+
+    def produce():
+        pipe.write(data)
+        pipe.close_write()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    out = b""
+    while True:
+        c = pipe.read(123457)
+        if not c:
+            break
+        out += c
+    t.join()
+    assert out == data
+
+
+def test_pipe_producer_error_surfaces():
+    def producer(sink):
+        sink.write(b"partial")
+        raise RuntimeError("decode exploded")
+
+    def consumer(source):
+        with pytest.raises(OSError, match="decode exploded"):
+            while source.read(1 << 16):
+                pass
+        return "saw-error"
+
+    assert streaming_copy(producer, consumer) == "saw-error"
+
+
+def test_pipe_consumer_abort_unblocks_producer():
+    """A consumer that stops reading must not deadlock the producer."""
+    blocked = []
+
+    def producer(sink):
+        try:
+            for _ in range(100):
+                sink.write(b"x" * (1 << 20))
+        except OSError:
+            blocked.append(True)
+
+    def consumer(source):
+        source.read(10)
+        raise RuntimeError("client went away")
+
+    with pytest.raises(RuntimeError):
+        streaming_copy(producer, consumer)
+    assert blocked  # producer saw PipeClosed, not a hang
+
+
+# -- streaming copy through the object layer -------------------------------
+
+
+@pytest.fixture()
+def layer(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK)
+    ol.make_bucket("cpb")
+    return ol
+
+
+def test_copy_object_streams_bounded(layer):
+    """Copy memory is set by the codec batch + pipe depth, NOT the
+    object size: doubling the object must not move the peak."""
+
+    def copy_peak(name, size, seed):
+        data = _payload(size, seed=seed)
+        layer.put_object("cpb", name, io.BytesIO(data), size)
+        tracemalloc.start()
+        layer.copy_object("cpb", name, "cpb", name + "-dst")
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        out = io.BytesIO()
+        layer.get_object("cpb", name + "-dst", out)
+        assert out.getvalue() == data
+        return peak
+
+    peak_small = copy_peak("src16", 16 << 20, 2)
+    peak_large = copy_peak("src64", 64 << 20, 5)
+    # 4x the object, ~same peak (slack for allocator noise)
+    assert peak_large < peak_small + (8 << 20), (
+        f"peak grew {peak_small >> 20} -> {peak_large >> 20} MiB"
+    )
+
+
+def test_copy_failure_leaves_no_partial(layer):
+    size = 4 << 20
+    data = _payload(size, seed=3)
+    layer.put_object("cpb", "fsrc", io.BytesIO(data), size)
+    # wreck the source so the copy's decode fails partway: truncate
+    # every shard file of the single part
+    fi, _ = layer._read_quorum_fileinfo("cpb", "fsrc")
+    for d in layer.disks:
+        p = d._file_path("cpb", f"fsrc/{fi.data_dir}/part.1")
+        with open(p, "r+b") as f:
+            f.truncate(100)
+    with pytest.raises(Exception):
+        layer.copy_object("cpb", "fsrc", "cpb", "fdst")
+    from minio_tpu.objectlayer.api import ObjectNotFound
+
+    with pytest.raises(ObjectNotFound):
+        layer.get_object_info("cpb", "fdst")
+
+
+# -- chunked internode CreateFile ------------------------------------------
+
+
+@pytest.fixture()
+def remote_disk(tmp_path):
+    root = str(tmp_path / "rsd")
+    local = XLStorage(root)
+    local.make_vol("sv")
+    srv = S3Server(
+        None, address="127.0.0.1:0", secret_key="str-sec",
+        internode_secret="str-sec",
+    )
+    srv.register_internode(
+        STORAGE_PREFIX, StorageRESTServer([local], "str-sec").handle
+    )
+    srv.start()
+    rc = StorageRESTClient("127.0.0.1", srv.port, root, "str-sec")
+    yield local, rc
+    srv.shutdown()
+
+
+def test_remote_createfile_streams(remote_disk):
+    local, rc = remote_disk
+    data = _payload(20 << 20, seed=4)
+    w = rc.create_file("sv", "big-shard")
+    for off in range(0, len(data), 3 << 20):
+        w.write(data[off : off + (3 << 20)])
+    w.close()
+    assert local.read_all("sv", "big-shard") == data
+
+
+def test_remote_createfile_error_is_oserror(remote_disk):
+    local, rc = remote_disk
+    w = rc.create_file("no-such-vol", "shard")
+    with pytest.raises(OSError):
+        w.write(b"data")
+        w.close()
+
+
+def test_remote_createfile_bad_token_rejected(remote_disk, tmp_path):
+    local, rc = remote_disk
+    bad = StorageRESTClient(
+        "127.0.0.1", rc.port, rc.disk_path, "wrong-secret"
+    )
+    w = bad.create_file("sv", "evil")
+    with pytest.raises(OSError):
+        w.write(b"data")
+        w.close()
+    try:
+        local.read_all("sv", "evil")
+        assert False, "unauthenticated stream landed on disk"
+    except Exception:
+        pass
+
+
+def test_self_copy_no_deadlock(layer):
+    """Metadata-rewrite self-copy must not deadlock the namespace lock
+    against the streaming pipe (review finding)."""
+    size = 8 << 20  # larger than pipe capacity
+    data = _payload(size, seed=9)
+    layer.put_object("cpb", "selfie", io.BytesIO(data), size)
+    info = layer.copy_object(
+        "cpb", "selfie", "cpb", "selfie", {"x-amz-meta-new": "tag"}
+    )
+    out = io.BytesIO()
+    layer.get_object("cpb", "selfie", out)
+    assert out.getvalue() == data
+    got = layer.get_object_info("cpb", "selfie")
+    assert got.user_defined.get("x-amz-meta-new") == "tag"
+
+
+def test_offline_peer_fast_fails_writer(tmp_path):
+    """A known-offline peer must fast-fail create_file, not stall a
+    socket timeout per shard (review finding)."""
+    import time
+
+    rc = StorageRESTClient("127.0.0.1", 1, "/nope", "sec", timeout=5)
+    rc._online = False
+    rc._last_probe = time.time()  # not yet due for a probe
+    import minio_tpu.storage.errors as serrors
+
+    with pytest.raises(serrors.DiskNotFound):
+        rc.create_file("v", "p")
