@@ -137,8 +137,18 @@ class HealRoutine:
                 else:
                     self._ol.heal_bucket(task.bucket)
                 self.healed += 1
-            except Exception:  # noqa: BLE001 - retried by later triggers
+            except Exception as e:  # noqa: BLE001 - retried by later triggers
                 self.failed += 1
+                from ..utils import log
+
+                log.logger("heal").warning(
+                    "heal task failed",
+                    extra=log.kv(
+                        bucket=task.bucket,
+                        object=task.object,
+                        error=f"{type(e).__name__}: {e}",
+                    ),
+                )
             finally:
                 self.queue.task_done()
             if self._throttle:

@@ -227,6 +227,10 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    from ..utils import log
+
+    log.setup(os.environ.get("MINIO_TPU_LOG_LEVEL", "info"))
+
     from ..cluster.endpoints import resolve_endpoints
     from ..storage.rest_server import StorageRESTServer
     from ..storage.rest_common import PREFIX as STORAGE_PREFIX
@@ -285,12 +289,22 @@ def main(argv=None) -> int:
         IAMSys(args.access_key, args.secret_key, ol)
     )
     _heal_routine, _disk_monitor = start_background_heal(ol)
+    srv.heal_routine = _heal_routine
+    srv.heal_queue = _heal_routine.queue
     si = ol.storage_info()
     print(
         f"minio-tpu serving {len(ol.zones)} zone(s) "
         f"{[z['disks'] for z in si['zones']]} drives at {srv.endpoint}"
     )
     sys.stdout.flush()
+    log.logger("server").info(
+        "online",
+        extra=log.kv(
+            endpoint=srv.endpoint,
+            zones=len(ol.zones),
+            drives=[z["disks"] for z in si["zones"]],
+        ),
+    )
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}, shutting down")
     srv.shutdown()

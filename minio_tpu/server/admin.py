@@ -1,0 +1,167 @@
+"""Admin API (cmd/admin-router.go:40-230 + admin-handlers.go subset).
+
+Mounted at ``/minio-tpu/admin/v1`` behind SigV4 auth; only the owner
+(root credential) may call it, mirroring the reference's adminAPI
+privilege default.  Surfaces: server/storage info, heal triggering,
+and IAM management (users, service accounts, canned policies) -
+the madmin-facing subset the console and mc rely on.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..iam.policy import Policy, PolicyError
+from ..iam.sys import IAMError, PolicyNotFound, UserNotFound
+from .s3errors import S3Error
+
+PREFIX = "/minio-tpu/admin/v1"
+VERSION = "0.3.0"
+_START = time.time()
+
+
+class AdminAPI:
+    """Routes one admin request; constructed per server."""
+
+    def __init__(self, server):
+        self.s3 = server
+
+    # -- dispatch ---------------------------------------------------------
+
+    def handle(
+        self, method: str, tail: str, q: "dict[str, str]", body: bytes
+    ) -> "tuple[int, bytes]":
+        ol = self.s3.object_layer
+        if ol is None:
+            raise S3Error("ServerNotInitialized")
+        route = (method, tail)
+        if route == ("GET", "info"):
+            return 200, self._info(ol)
+        if route == ("GET", "storageinfo"):
+            return 200, _json(ol.storage_info())
+        if route == ("POST", "heal"):
+            return 200, self._heal(ol, q)
+        # IAM management
+        iam = self.s3.iam
+        if route == ("GET", "list-users"):
+            return 200, _json(iam.list_users())
+        if route == ("PUT", "add-user"):
+            doc = _body_json(body)
+            iam.add_user(
+                _req(q, "accessKey"),
+                doc.get("secretKey", ""),
+                doc.get("policy", ""),
+            )
+            return 200, b"{}"
+        if route == ("DELETE", "remove-user"):
+            iam.remove_user(_req(q, "accessKey"))
+            return 200, b"{}"
+        if route == ("PUT", "set-user-policy"):
+            iam.set_user_policy(_req(q, "accessKey"), q.get("name", ""))
+            return 200, b"{}"
+        if route == ("PUT", "set-user-status"):
+            iam.set_user_status(
+                _req(q, "accessKey"), q.get("status") == "enabled"
+            )
+            return 200, b"{}"
+        if route == ("POST", "service-account"):
+            ak, sk = iam.add_service_account(_req(q, "parent"))
+            return 200, _json({"accessKey": ak, "secretKey": sk})
+        if route == ("GET", "list-canned-policies"):
+            return 200, _json(
+                {
+                    name: iam.get_policy(name).to_dict()
+                    for name in iam.list_policies()
+                }
+            )
+        if route == ("PUT", "add-canned-policy"):
+            try:
+                pol = Policy.from_json(body)
+            except PolicyError as e:
+                raise S3Error("MalformedPolicy", str(e)) from None
+            iam.set_policy(_req(q, "name"), pol)
+            return 200, b"{}"
+        if route == ("DELETE", "remove-canned-policy"):
+            iam.remove_policy(_req(q, "name"))
+            return 200, b"{}"
+        raise S3Error("MethodNotAllowed", f"admin {method} /{tail}")
+
+    # -- handlers ---------------------------------------------------------
+
+    def _info(self, ol) -> bytes:
+        si = ol.storage_info()
+        disks = []
+        from .metrics import _iter_disks
+
+        for d in _iter_disks(ol):
+            if d is None:
+                disks.append({"state": "offline"})
+                continue
+            try:
+                info = d.disk_info()
+                disks.append(
+                    {
+                        "endpoint": info.endpoint,
+                        "state": "ok" if d.is_online() else "offline",
+                        "total": info.total,
+                        "used": info.used,
+                        "free": info.free,
+                    }
+                )
+            except Exception:  # noqa: BLE001
+                disks.append({"state": "offline"})
+        return _json(
+            {
+                "version": VERSION,
+                "uptime_seconds": round(time.time() - _START, 1),
+                "mode": "erasure",
+                "storage": si,
+                "disks": disks,
+            }
+        )
+
+    def _heal(self, ol, q: "dict[str, str]") -> bytes:
+        bucket = q.get("bucket", "")
+        obj = q.get("object", "")
+        dry = q.get("dryRun") == "true"
+        if not bucket:
+            raise S3Error("InvalidArgument", "heal requires bucket")
+        if obj:
+            res = ol.heal_object(
+                bucket, obj, q.get("versionId", ""), dry_run=dry
+            )
+        else:
+            res = ol.heal_bucket(bucket, dry_run=dry)
+        return _json(res)
+
+
+def _json(doc) -> bytes:
+    return json.dumps(doc).encode()
+
+
+def _body_json(body: bytes) -> dict:
+    try:
+        doc = json.loads(body or b"{}")
+    except ValueError:
+        raise S3Error("InvalidArgument", "malformed JSON body") from None
+    if not isinstance(doc, dict):
+        raise S3Error("InvalidArgument", "JSON object expected")
+    return doc
+
+
+def _req(q: "dict[str, str]", key: str) -> str:
+    v = q.get(key, "")
+    if not v:
+        raise S3Error("InvalidArgument", f"missing {key}")
+    return v
+
+
+def map_admin_error(e: Exception) -> "S3Error | None":
+    if isinstance(e, UserNotFound):
+        return S3Error("InvalidArgument", f"no such user: {e}")
+    if isinstance(e, PolicyNotFound):
+        return S3Error("InvalidArgument", f"no such policy: {e}")
+    if isinstance(e, IAMError):
+        return S3Error("InvalidArgument", str(e))
+    return None

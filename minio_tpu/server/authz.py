@@ -152,9 +152,11 @@ def condition_values(
 
 
 def is_reserved_bucket(bucket: str) -> bool:
-    """The meta volume (and any dot-prefixed name) is never reachable
-    over S3 (isMinioMetaBucketName / reserved-bucket guard)."""
-    return bucket.startswith(".")
+    """The meta volume (any dot-prefixed name) and the router prefix
+    are never reachable as S3 buckets (isMinioMetaBucketName /
+    reserved-bucket guard; "minio-tpu" shadows the admin/metrics
+    mounts)."""
+    return bucket.startswith(".") or bucket == "minio-tpu"
 
 
 def authorize(

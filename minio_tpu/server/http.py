@@ -22,6 +22,7 @@ import io
 import os
 import socket
 import threading
+import time as _time
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
@@ -150,6 +151,16 @@ class S3Server:
         self.iam = iam or IAMSys(access_key, secret_key)
         self.verifier = SigV4Verifier(self.iam.lookup_secret, region)
         self._bucket_meta: "BucketMetadataSys | None" = None
+        from .metrics import Metrics
+
+        self.metrics = Metrics()
+        # "public" opens the scrape endpoint (MINIO_PROMETHEUS_AUTH_TYPE)
+        self.metrics_public = (
+            os.environ.get("MINIO_TPU_PROMETHEUS_AUTH_TYPE", "jwt")
+            == "public"
+        )
+        self.heal_routine = None  # attached by the server main
+        self.heal_queue = None
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         # internode planes (storage/lock/peer/bootstrap REST, the
@@ -330,8 +341,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         if body and self.command != "HEAD":
             self.wfile.write(body)
+            self._resp_bytes += len(body)
 
     def _error(self, err: s3errors.APIError, resource: str):
+        if err.status >= 500:
+            from ..utils import log
+
+            log.logger("http").error(
+                "request failed",
+                extra=log.kv(
+                    code=err.code,
+                    status=err.status,
+                    resource=resource,
+                    method=self.command,
+                ),
+            )
         if err.status == 304:  # Not Modified carries no body
             self._respond(304)
             return
@@ -345,6 +369,10 @@ class _Handler(BaseHTTPRequestHandler):
     def end_headers(self):
         self._headers_sent = True
         super().end_headers()
+
+    def send_response(self, code, message=None):
+        self._last_status = code  # metrics middleware reads this
+        super().send_response(code, message)
 
     def _finish_body(self) -> None:
         """Keep-alive hygiene: drain small unread remainders, otherwise
@@ -375,6 +403,9 @@ class _Handler(BaseHTTPRequestHandler):
         self._headers_sent = False
         self._raw_body = None
         self._auth = None
+        self._action = ""
+        self._last_status = 0
+        self._resp_bytes = 0
         for prefix, handler in self.s3.internode.items():
             if path.startswith(prefix + "/"):
                 return self._route_internode(
@@ -389,6 +420,52 @@ class _Handler(BaseHTTPRequestHandler):
             if self.s3.object_layer is None:
                 return self._respond(503, content_type="text/plain")
             return self._respond(200, content_type="text/plain")
+        if path == "/minio-tpu/prometheus/metrics":
+            self._finish_body()
+            if not self.s3.metrics_public:
+                # authenticated scrapes only by default (the reference
+                # guards /minio/prometheus/metrics with JWT)
+                try:
+                    ctx = self.s3.verifier.verify_stream(
+                        self.command, path, query,
+                        dict(self.headers.items()),
+                    )
+                except AuthError:
+                    return self._respond(
+                        403, b"forbidden", content_type="text/plain"
+                    )
+                if ctx.anonymous:
+                    return self._respond(
+                        403, b"forbidden", content_type="text/plain"
+                    )
+            return self._respond(
+                200,
+                self.s3.metrics.render(
+                    self.s3.object_layer,
+                    self.s3.heal_routine,
+                    self.s3.heal_queue,
+                ),
+                content_type="text/plain; version=0.0.4",
+            )
+        t0 = _time.monotonic()
+        try:
+            self._route_authed(path, query)
+        finally:
+            # collectAPIStats analogue: every authed-path request lands
+            # in the metrics registry
+            try:
+                cl = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                cl = 0
+            self.s3.metrics.observe(
+                self._action or "Unknown",
+                self._last_status or 0,
+                _time.monotonic() - t0,
+                bytes_in=cl,
+                bytes_out=self._resp_bytes,
+            )
+
+    def _route_authed(self, path: str, query) -> None:
         try:
             # safe mode: every S3 request is 503 until the object layer
             # attaches, even unauthenticated ones (server-main.go safe
@@ -405,6 +482,12 @@ class _Handler(BaseHTTPRequestHandler):
                 self.command, path, query, dict(self.headers.items())
             )
             self._auth = ctx
+            from . import admin as adminmod
+
+            if path.startswith(adminmod.PREFIX + "/"):
+                return self._route_admin(
+                    path[len(adminmod.PREFIX) + 1 :], query, ctx
+                )
             self._authorize(path, query, ctx)
             self._dispatch(path, query)
         except Exception as e:  # noqa: BLE001
@@ -417,6 +500,33 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(s3errors.from_exception(e), path)
         else:
             self._finish_body()
+
+    def _route_admin(self, tail: str, query, ctx) -> None:
+        """Admin plane: SigV4-authenticated, owner-only
+        (adminAPIHandlers privilege default)."""
+        from .admin import AdminAPI, map_admin_error
+
+        # metrics label only after the owner check: unauthenticated
+        # garbage paths must not mint registry keys (cardinality)
+        self._action = "Admin"
+        if ctx.anonymous or not self.s3.iam.is_owner(ctx.access_key):
+            raise S3Error("AccessDenied", "admin requires the owner")
+        self._action = f"Admin.{tail}"
+        body = b""
+        if self.command in ("PUT", "POST"):
+            body = self._read_body()
+        q1 = {k: v[0] for k, v in query.items()}
+        try:
+            status, payload = AdminAPI(self.s3).handle(
+                self.command, tail, q1, body
+            )
+        except Exception as e:  # noqa: BLE001
+            mapped = map_admin_error(e)
+            if mapped is None:
+                raise
+            raise mapped from e
+        self._finish_body()
+        self._respond(status, payload, content_type="application/json")
 
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = route
 
@@ -468,6 +578,7 @@ class _Handler(BaseHTTPRequestHandler):
         action = authz.action_for_request(
             self.command, bucket, key, query, dict(self.headers.items())
         )
+        self._action = action.partition(":")[2]  # metrics API label
         if not self._check_action(action, bucket, key, ctx.access_key):
             raise S3Error("AccessDenied")
         # CopyObject/UploadPartCopy additionally need read access on the
@@ -977,6 +1088,7 @@ class _Handler(BaseHTTPRequestHandler):
             ol.get_object(
                 bucket, key, self.wfile, lo, length, version_id
             )
+            self._resp_bytes += length
         except Exception:  # noqa: BLE001
             # headers already sent; the only honest signal is a broken
             # connection (the reference behaves the same mid-stream)

@@ -1,0 +1,189 @@
+"""Prometheus metrics (cmd/metrics.go:66-507).
+
+A process-local registry fed by the request middleware plus live
+gauges scraped from the object layer (per-disk usage) and the heal
+routine, rendered in the Prometheus text exposition format at
+``/minio-tpu/prometheus/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+START_TIME = time.time()
+
+
+class Metrics:
+    """Thread-safe counters for the serving path."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (api, code) -> count
+        self.requests: "dict[tuple[str, str], int]" = {}
+        # api -> [count, total_seconds]
+        self.latency: "dict[str, list]" = {}
+        self.bytes_rx = 0
+        self.bytes_tx = 0
+
+    def observe(
+        self,
+        api: str,
+        code: int,
+        seconds: float,
+        bytes_in: int = 0,
+        bytes_out: int = 0,
+    ) -> None:
+        with self._mu:
+            key = (api, str(code))
+            self.requests[key] = self.requests.get(key, 0) + 1
+            lat = self.latency.setdefault(api, [0, 0.0])
+            lat[0] += 1
+            lat[1] += seconds
+            self.bytes_rx += bytes_in
+            self.bytes_tx += bytes_out
+
+    # -- rendering --------------------------------------------------------
+
+    def render(self, object_layer=None, heal=None, queue=None) -> bytes:
+        """The exposition document; live gauges are sampled now."""
+        out: list[str] = []
+
+        def emit(name, mtype, help_, samples):
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {mtype}")
+            for labels, value in samples:
+                lbl = (
+                    "{"
+                    + ",".join(f'{k}="{v}"' for k, v in labels.items())
+                    + "}"
+                    if labels
+                    else ""
+                )
+                out.append(f"{name}{lbl} {value}")
+
+        with self._mu:
+            reqs = dict(self.requests)
+            lat = {k: list(v) for k, v in self.latency.items()}
+            rx, tx = self.bytes_rx, self.bytes_tx
+
+        emit(
+            "miniotpu_s3_requests_total",
+            "counter",
+            "S3 requests by API and HTTP code",
+            [
+                ({"api": api, "code": code}, n)
+                for (api, code), n in sorted(reqs.items())
+            ],
+        )
+        emit(
+            "miniotpu_s3_request_seconds_total",
+            "counter",
+            "Cumulative request wall time by API",
+            [
+                ({"api": api}, f"{total:.6f}")
+                for api, (_n, total) in sorted(lat.items())
+            ],
+        )
+        emit(
+            "miniotpu_s3_request_seconds_count",
+            "counter",
+            "Requests counted toward request_seconds by API",
+            [({"api": api}, n) for api, (n, _t) in sorted(lat.items())],
+        )
+        emit(
+            "miniotpu_s3_rx_bytes_total", "counter",
+            "Bytes received from S3 clients", [({}, rx)],
+        )
+        emit(
+            "miniotpu_s3_tx_bytes_total", "counter",
+            "Bytes sent to S3 clients", [({}, tx)],
+        )
+        emit(
+            "miniotpu_process_uptime_seconds", "gauge",
+            "Seconds since process start",
+            [({}, f"{time.time() - START_TIME:.1f}")],
+        )
+
+        if object_layer is not None:
+            disks, usage = _disk_samples(object_layer)
+            emit(
+                "miniotpu_disks_total", "gauge",
+                "Configured disks", [({}, disks[0])],
+            )
+            emit(
+                "miniotpu_disks_offline", "gauge",
+                "Offline disks", [({}, disks[1])],
+            )
+            emit(
+                "miniotpu_disk_storage_used_bytes", "gauge",
+                "Used bytes per disk",
+                [({"disk": ep}, u) for ep, (u, _f, _t) in usage],
+            )
+            emit(
+                "miniotpu_disk_storage_available_bytes", "gauge",
+                "Free bytes per disk",
+                [({"disk": ep}, f) for ep, (_u, f, _t) in usage],
+            )
+            emit(
+                "miniotpu_disk_storage_total_bytes", "gauge",
+                "Capacity per disk",
+                [({"disk": ep}, t) for ep, (_u, _f, t) in usage],
+            )
+        if heal is not None:
+            emit(
+                "miniotpu_heal_objects_healed_total", "counter",
+                "Objects healed by the background routine",
+                [({}, heal.healed)],
+            )
+            emit(
+                "miniotpu_heal_objects_failed_total", "counter",
+                "Background heals that failed",
+                [({}, heal.failed)],
+            )
+        if queue is not None:
+            emit(
+                "miniotpu_heal_queue_depth", "gauge",
+                "Tasks waiting in the heal queue",
+                [({}, len(queue))],
+            )
+        return ("\n".join(out) + "\n").encode()
+
+
+def _iter_disks(object_layer):
+    zones = getattr(object_layer, "zones", None)
+    if zones is not None:
+        for z in zones:
+            yield from _iter_disks(z)
+        return
+    sets = getattr(object_layer, "sets", None)
+    if sets is not None:
+        for s in sets:
+            yield from _iter_disks(s)
+        return
+    yield from getattr(object_layer, "disks", [])
+
+
+def _disk_samples(object_layer):
+    total = offline = 0
+    usage = []
+    for d in _iter_disks(object_layer):
+        total += 1
+        if d is None or not _safe_online(d):
+            offline += 1
+            continue
+        try:
+            info = d.disk_info()
+            usage.append(
+                (info.endpoint, (info.used, info.free, info.total))
+            )
+        except Exception:  # noqa: BLE001
+            offline += 1
+    return (total, offline), usage
+
+
+def _safe_online(d) -> bool:
+    try:
+        return d.is_online()
+    except Exception:  # noqa: BLE001
+        return False

@@ -1,0 +1,63 @@
+"""Structured JSON logging (cmd/logger analogue).
+
+One line per event: ``{"ts": ..., "level": ..., "name": ..., "msg":
+..., **fields}``.  Console-friendly in dev (MINIO_TPU_LOG=console
+switches to plain text); the JSON shape is what the reference's
+logger targets emit (cmd/logger/logger.go:301-389).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+class _JSONFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": datetime.datetime.fromtimestamp(
+                record.created, tz=datetime.timezone.utc
+            ).isoformat(timespec="milliseconds"),
+            "level": record.levelname.lower(),
+            "name": record.name,
+            "msg": record.getMessage(),
+        }
+        extra = getattr(record, "fields", None)
+        if extra:
+            doc.update(extra)
+        if record.exc_info and record.exc_info[0] is not None:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def setup(level: str = "info") -> None:
+    """Install the process-wide handler (idempotent)."""
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    _CONFIGURED = True
+    root = logging.getLogger("minio_tpu")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    h = logging.StreamHandler(sys.stdout)
+    if os.environ.get("MINIO_TPU_LOG", "json") == "console":
+        h.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+        )
+    else:
+        h.setFormatter(_JSONFormatter())
+    root.addHandler(h)
+    root.propagate = False
+
+
+def logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"minio_tpu.{name}")
+
+
+def kv(**fields) -> dict:
+    """Attach structured fields: log.info("msg", extra=kv(bucket=b))."""
+    return {"fields": fields}
