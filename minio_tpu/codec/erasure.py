@@ -25,7 +25,7 @@ import dataclasses
 
 import numpy as np
 
-from . import backend as backend_mod, bitrot
+from . import backend as backend_mod, bitrot, compress
 
 BLOCK_SIZE_V1 = 10 * 1024 * 1024  # reference blockSizeV1
 DEFAULT_BATCH_BLOCKS = 4
@@ -225,7 +225,14 @@ class Erasure:
                 lo = max(offset, block_start) - block_start
                 hi = min(offset + length, block_start + block_len) - block_start
                 if hi > lo:
-                    writer.write(datas[j][lo:hi])
+                    try:
+                        writer.write(datas[j][lo:hi])
+                    except compress.RangeSatisfied:
+                        # a skipping decompressor downstream has its
+                        # full range: stop paying decode I/O, but keep
+                        # the heal verdict observed so far (losing it
+                        # here would mask bitrot on range reads)
+                        return written, heal_required
                     written += hi - lo
             bi += len(batch_idx)
         return written, heal_required

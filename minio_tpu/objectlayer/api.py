@@ -186,6 +186,21 @@ def check_object_name(name: str) -> None:
         raise InvalidObjectName(name)
 
 
+def prepare_copy_meta(src_info, metadata: "dict | None") -> dict:
+    """Destination metadata for CopyObject: source user metadata with
+    directive overrides applied, minus the etag and the internal
+    compression markers - the copy pipe carries decompressed plaintext
+    and the destination put re-decides compression, so stale markers
+    would make GET return raw deflate bytes."""
+    from ..codec.compress import strip_internal_meta
+
+    meta = dict(src_info.user_defined)
+    if metadata:
+        meta.update(metadata)
+    meta.pop("etag", None)
+    return strip_internal_meta(meta)
+
+
 class ObjectLayer:
     """Abstract object store (subset grows as surfaces land)."""
 
@@ -205,7 +220,8 @@ class ObjectLayer:
     # objects
     def put_object(
         self, bucket: str, object_name: str, reader, size: int = -1,
-        metadata: "dict | None" = None,
+        metadata: "dict | None" = None, versioned: bool = False,
+        compress: "bool | None" = None,
     ) -> ObjectInfo:
         raise NotImplementedError
 
@@ -228,6 +244,7 @@ class ObjectLayer:
     def copy_object(
         self, src_bucket: str, src_object: str, dst_bucket: str,
         dst_object: str, metadata: "dict | None" = None,
+        versioned: bool = False,
     ) -> ObjectInfo:
         raise NotImplementedError
 

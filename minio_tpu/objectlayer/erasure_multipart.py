@@ -19,6 +19,7 @@ from __future__ import annotations
 import hashlib
 import uuid
 
+from ..codec import compress as compmod
 from ..codec.erasure import Erasure, QuorumError
 from ..storage import errors as serrors
 from ..storage.meta import (
@@ -82,6 +83,13 @@ class MultipartMixin:
         meta = dict(metadata or {})
         meta["x-internal-bucket"] = bucket
         meta["x-internal-object"] = object_name
+        # compression is decided once per upload (part sizes are
+        # unknown up front - streaming semantics) and every part
+        # inherits it so the assembled object is uniformly coded
+        if compmod.should_compress(
+            object_name, meta.get("content-type", ""), -1
+        ):
+            meta[compmod.META_COMPRESSION] = compmod.ALGORITHM
         distribution = hash_order(
             f"{bucket}/{object_name}", len(self.disks)
         )
@@ -125,6 +133,11 @@ class MultipartMixin:
             self.data_blocks, self.parity_blocks, self.block_size
         )
         hreader = HashReader(reader, size)
+        # each part is an independent deflate stream: the GET path can
+        # then skip whole parts by actual size and the part ETag stays
+        # the plaintext MD5 the client computed
+        compress = bool(mfi.metadata.get(compmod.META_COMPRESSION))
+        src = compmod.CompressReader(hreader) if compress else hreader
         disks = shuffle_disks(
             self._online_disks(), mfi.erasure.distribution
         )
@@ -143,7 +156,7 @@ class MultipartMixin:
             except Exception:  # noqa: BLE001
                 writers.append(None)
         try:
-            total = er.encode(hreader, writers, self.write_quorum)
+            total = er.encode(src, writers, self.write_quorum)
         except QuorumError as e:
             # close writers FIRST: streaming remote writers own sender
             # threads that must terminate before staging is reaped
@@ -162,6 +175,7 @@ class MultipartMixin:
                 except OSError:
                     pass
         etag = hreader.etag()
+        actual = hreader.bytes_read
         mod = now_ns()
         # commit shard into the upload dir + record part metadata
         errs = []
@@ -179,7 +193,7 @@ class MultipartMixin:
                 d.write_all(
                     SYS_VOL,
                     f"{self._mp_path(upload_id)}/part.{part_number}.meta",
-                    f"{total}:{etag}:{mod}".encode(),
+                    f"{total}:{etag}:{mod}:{actual}".encode(),
                 )
                 d.delete_file(SYS_VOL, f"tmp/{tmp_ids[i]}", recursive=True)
                 errs.append(None)
@@ -189,14 +203,15 @@ class MultipartMixin:
         return PartInfo(
             part_number=part_number,
             etag=etag,
-            size=total,
-            actual_size=total,
+            size=actual,
+            actual_size=actual,
             mod_time_ns=mod,
         )
 
     def _read_part_meta(
         self, upload_id: str, part_number: int
-    ) -> "tuple[int, str, int] | None":
+    ) -> "tuple[int, str, int, int] | None":
+        """-> (stored_size, etag, mod_time, actual_size)."""
         for d in self._online_disks():
             if d is None:
                 continue
@@ -205,8 +220,10 @@ class MultipartMixin:
                     SYS_VOL,
                     f"{self._mp_path(upload_id)}/part.{part_number}.meta",
                 ).decode()
-                size, etag, mod = raw.split(":")
-                return int(size), etag, int(mod)
+                fields = raw.split(":")
+                size, etag, mod = fields[0], fields[1], fields[2]
+                actual = fields[3] if len(fields) > 3 else size
+                return int(size), etag, int(mod), int(actual)
             except Exception:  # noqa: BLE001
                 continue
         return None
@@ -233,9 +250,10 @@ class MultipartMixin:
             pm = self._read_part_meta(upload_id, n)
             if pm is None:
                 continue
-            size, etag, mod = pm
+            _size, etag, mod, actual = pm
+            # clients always see the plaintext (actual) part size
             out.append(
-                PartInfo(n, etag, size, size, mod)
+                PartInfo(n, etag, actual, actual, mod)
             )
             if len(out) >= max_parts:
                 break
@@ -303,9 +321,10 @@ class MultipartMixin:
         if not parts:
             raise InvalidPart("no parts")
         # validate + collect part metadata
-        infos: list[tuple[CompletePart, int]] = []
+        infos: list[tuple[CompletePart, int, int]] = []
         md5s = hashlib.md5()
         total = 0
+        total_actual = 0
         last = 0
         min_part = getattr(self, "min_part_size", MIN_PART_SIZE)
         for i, cp in enumerate(parts):
@@ -315,18 +334,20 @@ class MultipartMixin:
             pm = self._read_part_meta(upload_id, cp.part_number)
             if pm is None:
                 raise InvalidPart(f"part {cp.part_number} not found")
-            size, etag, _ = pm
+            size, etag, _, actual = pm
             if cp.etag and cp.etag.strip('"') != etag:
                 raise InvalidPart(f"part {cp.part_number} etag mismatch")
-            # S3 minimum part size applies to all but the last part
-            # (cmd/erasure-multipart.go CompleteMultipartUpload)
-            if i != len(parts) - 1 and size < min_part:
+            # S3 minimum part size applies to all but the last part and
+            # to the CLIENT-visible bytes (a compressed part may store
+            # far fewer; cmd/erasure-multipart.go checks ActualSize)
+            if i != len(parts) - 1 and actual < min_part:
                 raise api.EntityTooSmall(
-                    f"part {cp.part_number} is {size} bytes"
+                    f"part {cp.part_number} is {actual} bytes"
                 )
-            infos.append((cp, size))
+            infos.append((cp, size, actual))
             md5s.update(bytes.fromhex(etag))
             total += size
+            total_actual += actual
         final_etag = f"{md5s.hexdigest()}-{len(parts)}"
         mod_time = now_ns()
         data_dir = uuid.uuid4().hex
@@ -338,6 +359,9 @@ class MultipartMixin:
             if not k.startswith("x-internal-")
         }
         meta["etag"] = final_etag
+        if mfi.metadata.get(compmod.META_COMPRESSION):
+            meta[compmod.META_COMPRESSION] = compmod.ALGORITHM
+            meta[compmod.META_ACTUAL_SIZE] = str(total_actual)
 
         with self.nslock.write(bucket, object_name):
             version_id = new_version_id() if versioned else ""
@@ -362,8 +386,8 @@ class MultipartMixin:
                     mod_time_ns=mod_time,
                     metadata=meta,
                     parts=[
-                        ObjectPartInfo(idx + 1, size, size)
-                        for idx, (cp, size) in enumerate(infos)
+                        ObjectPartInfo(idx + 1, size, actual)
+                        for idx, (cp, size, actual) in enumerate(infos)
                     ],
                     erasure=ErasureInfo(
                         data_blocks=self.data_blocks,
@@ -376,7 +400,7 @@ class MultipartMixin:
                 try:
                     # move chosen parts into the staged data dir,
                     # renumbered consecutively (part.N -> part.idx+1)
-                    for idx, (cp, _size) in enumerate(infos):
+                    for idx, (cp, _size, _actual) in enumerate(infos):
                         d.rename_file(
                             SYS_VOL,
                             f"{self._mp_path(upload_id)}/part.{cp.part_number}",
@@ -396,7 +420,7 @@ class MultipartMixin:
                 # roll the staged parts back into the upload dir so the
                 # client can retry CompleteMultipartUpload
                 for d, tmp in staged:
-                    for idx, (cp, _size) in enumerate(infos):
+                    for idx, (cp, _size, _actual) in enumerate(infos):
                         try:
                             d.rename_file(
                                 SYS_VOL,
@@ -438,7 +462,7 @@ class MultipartMixin:
         return ObjectInfo(
             bucket=bucket,
             name=object_name,
-            size=total,
+            size=total_actual,  # clients see plaintext bytes
             mod_time_ns=mod_time,
             etag=final_etag,
             content_type=meta.get("content-type", ""),

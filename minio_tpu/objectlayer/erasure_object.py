@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 import uuid
 
-from ..codec import erasure as ecodec
+from ..codec import compress as compmod, erasure as ecodec
 from ..codec.erasure import Erasure, QuorumError
 from ..storage import errors as serrors
 from ..storage.meta import (
@@ -189,13 +189,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
     def put_object(
         self, bucket, object_name, reader, size=-1, metadata=None,
-        versioned=False,
+        versioned=False, compress=None,
     ) -> ObjectInfo:
         check_object_name(object_name)
         self._require_bucket(bucket)
         with self.nslock.write(bucket, object_name):
             return self._put_object(
-                bucket, object_name, reader, size, metadata, versioned
+                bucket, object_name, reader, size, metadata, versioned,
+                compress,
             )
 
     def _old_null_data_dir(self, bucket, object_name) -> str:
@@ -212,13 +213,27 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
     def _put_object(
         self, bucket, object_name, reader, size, metadata,
-        versioned=False,
+        versioned=False, compress=None,
     ) -> ObjectInfo:
         k, m, n = self.data_blocks, self.parity_blocks, len(self.disks)
         er = Erasure(k, m, self.block_size)
         hreader = (
             reader if isinstance(reader, HashReader) else HashReader(reader, size)
         )
+        # transparent compression: the decision lives HERE so every
+        # write path (PUT, POST-policy, CopyObject re-encode) shares it;
+        # the codec sees STORED (deflate) bytes while the HashReader
+        # keeps hashing the client payload so the ETag stays the
+        # original MD5 (object-api-utils.go:434 seam)
+        if compress is None:
+            compress = compmod.should_compress(
+                object_name,
+                (metadata or {}).get("content-type", ""),
+                size,
+            )
+        src = hreader
+        if compress:
+            src = compmod.CompressReader(hreader)
         distribution = hash_order(f"{bucket}/{object_name}", n)
         disks = shuffle_disks(self._online_disks(), distribution)
 
@@ -239,7 +254,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 writers.append(None)
 
         try:
-            total = er.encode(hreader, writers, self.write_quorum)
+            total = er.encode(src, writers, self.write_quorum)
         except QuorumError as e:
             # close writers FIRST: streaming remote writers own sender
             # threads that must terminate before staging is reaped
@@ -260,8 +275,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
         mod_time = now_ns()
         etag = hreader.etag()
+        actual_size = hreader.bytes_read
         meta = dict(metadata or {})
         meta.setdefault("etag", etag)
+        if compress:
+            meta[compmod.META_COMPRESSION] = compmod.ALGORITHM
+            meta[compmod.META_ACTUAL_SIZE] = str(actual_size)
         # versioned PUT mints a fresh id and preserves prior versions;
         # unversioned/suspended PUT overwrites the null version only
         # (xl-storage-format-v2 version journal semantics)
@@ -283,7 +302,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 size=total,
                 mod_time_ns=mod_time,
                 metadata=meta,
-                parts=[ObjectPartInfo(1, total, total)],
+                parts=[ObjectPartInfo(1, total, actual_size)],
                 erasure=ErasureInfo(
                     data_blocks=k,
                     parity_blocks=m,
@@ -329,7 +348,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         return ObjectInfo(
             bucket=bucket,
             name=object_name,
-            size=total,
+            size=actual_size,  # clients always see the original size
             mod_time_ns=mod_time,
             etag=etag,
             content_type=meta.get("content-type", ""),
@@ -382,10 +401,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
     @staticmethod
     def _to_object_info(bucket, object_name, fi: FileInfo) -> ObjectInfo:
+        size = fi.size
+        if fi.metadata.get(compmod.META_COMPRESSION):
+            # clients see the original payload size, not stored bytes
+            size = int(fi.metadata.get(compmod.META_ACTUAL_SIZE, size))
         return ObjectInfo(
             bucket=bucket,
             name=object_name,
-            size=fi.size,
+            size=size,
             mod_time_ns=fi.mod_time_ns,
             etag=fi.metadata.get("etag", ""),
             content_type=fi.metadata.get("content-type", ""),
@@ -407,11 +430,17 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             )
             if fi.deleted:
                 raise ObjectNotFound(f"{bucket}/{object_name}")
+            compressed = bool(fi.metadata.get(compmod.META_COMPRESSION))
+            logical_size = fi.size
+            if compressed:
+                logical_size = int(
+                    fi.metadata.get(compmod.META_ACTUAL_SIZE, fi.size)
+                )
             if length < 0:
-                length = fi.size - offset
-            if offset < 0 or offset + length > fi.size:
+                length = logical_size - offset
+            if offset < 0 or offset + length > logical_size:
                 raise api.InvalidRange(
-                    f"range {offset}+{length} of {fi.size}"
+                    f"range {offset}+{length} of {logical_size}"
                 )
             er = Erasure(
                 fi.erasure.data_blocks,
@@ -422,24 +451,40 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 self._online_disks(), fi.erasure.distribution
             )
             heal_required = False
-            # stream parts covering [offset, offset+length)
+            # stream the parts covering [offset, offset+length).  Ranges
+            # address LOGICAL bytes; for compressed objects each part is
+            # an independent deflate stream, so overlapping parts are
+            # decoded whole into a skipping decompressor (the
+            # decompress-and-skip of object-api-utils.go:686) while
+            # uncompressed parts decode just the overlapping slice.
             part_off = 0
             remaining = length
             cur = offset
             for part in fi.parts:
+                span = part.actual_size if compressed else part.size
                 part_start = part_off
-                part_end = part_off + part.size
+                part_end = part_off + span
                 part_off = part_end
-                if remaining <= 0 or part_end <= cur:
+                if remaining <= 0:
+                    break
+                if part_end <= cur:
                     continue
                 in_off = cur - part_start
-                in_len = min(part.size - in_off, remaining)
+                in_len = min(span - in_off, remaining)
+                if compressed:
+                    sink = compmod.DecompressWriter(writer, in_off, in_len)
+                    dec_off, dec_len = 0, part.size
+                else:
+                    sink = writer
+                    dec_off, dec_len = in_off, in_len
                 readers = self._part_readers(
                     disks, bucket, object_name, fi, part.number
                 )
                 try:
+                    # decode returns early (heal verdict intact) once a
+                    # downstream DecompressWriter's range is satisfied
                     _, healed = er.decode(
-                        writer, readers, in_off, in_len, part.size
+                        sink, readers, dec_off, dec_len, part.size
                     )
                 except QuorumError as e:
                     raise ReadQuorumError(str(e)) from e
@@ -451,6 +496,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                             except Exception:  # noqa: BLE001
                                 pass
                 heal_required = heal_required or healed
+                if compressed:
+                    sink.finish()
                 cur += in_len
                 remaining -= in_len
             info = self._to_object_info(bucket, object_name, fi)
@@ -590,10 +637,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         from ..utils.pipe import streaming_copy
 
         src_info = self.get_object_info(src_bucket, src_object)
-        meta = dict(src_info.user_defined)
-        if metadata:
-            meta.update(metadata)
-        meta.pop("etag", None)
+        meta = api.prepare_copy_meta(src_info, metadata)
         if src_bucket == dst_bucket and src_object == dst_object:
             # self-copy (metadata rewrite): the concurrent pipe would
             # deadlock the namespace lock against itself - run the read

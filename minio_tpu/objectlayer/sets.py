@@ -96,9 +96,10 @@ class ErasureSets(ObjectLayer):
     # -- objects (route by key) -------------------------------------------
 
     def put_object(self, bucket, object_name, reader, size=-1, metadata=None,
-                   versioned=False):
+                   versioned=False, compress=None):
         return self.set_for(object_name).put_object(
-            bucket, object_name, reader, size, metadata, versioned
+            bucket, object_name, reader, size, metadata, versioned,
+            compress,
         )
 
     def get_object(self, bucket, object_name, writer, offset=0, length=-1,
@@ -130,10 +131,7 @@ class ErasureSets(ObjectLayer):
         from ..utils.pipe import streaming_copy
 
         info = src_set.get_object_info(src_bucket, src_object)
-        meta = dict(info.user_defined)
-        if metadata:
-            meta.update(metadata)
-        meta.pop("etag", None)
+        meta = api.prepare_copy_meta(info, metadata)
         return streaming_copy(
             lambda sink: src_set.get_object(src_bucket, src_object, sink),
             lambda source: dst_set.put_object(

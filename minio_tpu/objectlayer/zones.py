@@ -108,11 +108,12 @@ class ErasureZones(ObjectLayer):
     # -- objects ----------------------------------------------------------
 
     def put_object(self, bucket, object_name, reader, size=-1, metadata=None,
-                   versioned=False):
+                   versioned=False, compress=None):
         self.zones[0].get_bucket_info(bucket)  # bucket must exist
         zi = self._put_zone_index(bucket, object_name)
         return self.zones[zi].put_object(
-            bucket, object_name, reader, size, metadata, versioned
+            bucket, object_name, reader, size, metadata, versioned,
+            compress,
         )
 
     def get_object(self, bucket, object_name, writer, offset=0, length=-1,
@@ -175,10 +176,7 @@ class ErasureZones(ObjectLayer):
                 metadata, versioned,
             )
         info = src_zone.get_object_info(src_bucket, src_object)
-        meta = dict(info.user_defined)
-        if metadata:
-            meta.update(metadata)
-        meta.pop("etag", None)
+        meta = api.prepare_copy_meta(info, metadata)
         return streaming_copy(
             lambda sink: src_zone.get_object(src_bucket, src_object, sink),
             lambda source: self.put_object(

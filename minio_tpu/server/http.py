@@ -1134,6 +1134,8 @@ class _Handler(BaseHTTPRequestHandler):
             raise S3Error("EntityTooLarge")
         hreader = self._hash_reader(reader, size)
         versioned, _ = self._versioning(bucket)
+        # transparent compression (MINIO_TPU_COMPRESS) is decided inside
+        # the object layer so POST-policy/multipart/copy share the seam
         info = self.s3.object_layer.put_object(
             bucket, key, hreader, size, self._collect_user_metadata(),
             versioned=versioned,
