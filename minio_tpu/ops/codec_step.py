@@ -193,48 +193,73 @@ def decode_and_verify(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("parity_shards", "shard_len", "reps")
+    jax.jit, static_argnames=("parity_shards", "shard_len")
 )
 def encode_throughput_probe(
-    words: jax.Array, parity_shards: int, shard_len: int, reps: int
+    words: jax.Array, parity_shards: int, shard_len: int, reps
 ):
     """Run `reps` dependent encode+hash passes inside ONE device program.
 
     Chains iterations through a cheap XOR so XLA cannot elide work,
     letting per-pass device time be measured without host launch overhead
-    (significant over the dev relay).  Returns a small checksum array.
+    (significant over the dev relay).  `reps` is a DYNAMIC trip count
+    (fori_loop), so one compiled program serves every chain length the
+    adaptive bench harness probes.  Returns a small checksum array.
     """
-    def body(carry, _):
+    def body(_, carry):
+        words_c, acc = carry
         parity, digests = encode_and_hash_words(
-            carry, parity_shards, shard_len
+            words_c, parity_shards, shard_len
         )
-        nxt = carry ^ parity[:, :1]
-        return nxt, digests[0, 0, 0]
+        return words_c ^ parity[:, :1], acc ^ digests[0, 0, 0]
 
-    final, sums = jax.lax.scan(body, words, None, length=reps)
-    return final[0, 0, :8], sums
+    final, acc = jax.lax.fori_loop(
+        0, reps, body, (words, jnp.uint32(0))
+    )
+    return final[0, 0, :8], acc
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("present", "data_shards", "parity_shards", "reps"),
+    static_argnames=("present", "data_shards", "parity_shards"),
 )
 def reconstruct_throughput_probe(
     shards: jax.Array,
     present: tuple[bool, ...],
     data_shards: int,
     parity_shards: int,
-    reps: int,
+    reps,
 ):
     """Chained batched static-pattern reconstructs (see encode probe)."""
     k = data_shards
 
-    def body(carry, _):
+    def body(_, carry):
+        shards_c, acc = carry
         data = reconstruct_words_batch(
-            carry, present, data_shards, parity_shards
+            shards_c, present, data_shards, parity_shards
         )
-        nxt = carry.at[:, :k].set(carry[:, :k] ^ data)
-        return nxt, data[0, 0, 0]
+        nxt = shards_c.at[:, :k].set(shards_c[:, :k] ^ data)
+        return nxt, acc ^ data[0, 0, 0]
 
-    final, sums = jax.lax.scan(body, shards, None, length=reps)
-    return final[0, 0, :8], sums
+    final, acc = jax.lax.fori_loop(
+        0, reps, body, (shards, jnp.uint32(0))
+    )
+    return final[0, 0, :8], acc
+
+
+@functools.partial(jax.jit, static_argnames=("shard_len",))
+def verify_throughput_probe(
+    shards: jax.Array, digests: jax.Array, shard_len: int, reps
+):
+    """Chained bitrot-verify passes: the HEALTHY read path (no RS math,
+    just the device hash + compare every streamed block pays)."""
+    def body(_, carry):
+        shards_c, acc = carry
+        ok = verify_hashes_words(shards_c, digests, shard_len)
+        nxt = shards_c ^ jnp.where(ok[0, 0], 0, 1).astype(shards_c.dtype)
+        return nxt, acc ^ ok.sum().astype(jnp.uint32)
+
+    final, acc = jax.lax.fori_loop(
+        0, reps, body, (shards, jnp.uint32(0))
+    )
+    return final[0, 0, :8], acc
