@@ -1,0 +1,438 @@
+"""Peer REST control plane + bootstrap handshake.
+
+The cross-node control channel the reference runs next to the storage
+and lock planes (cmd/peer-rest-server.go:1035 registers ~28 methods;
+cmd/peer-rest-client.go; cmd/bootstrap-peer-server.go:109 verifies the
+cluster config at boot).  Mounted on each node's single internode
+listener under ``/minio-tpu/peer/v1/<method>``: msgpack request/response
+documents, internode JWT on every call.
+
+Three jobs:
+- **invalidation**: bucket-metadata and IAM edits made on one node are
+  pushed to every peer so their caches reload immediately instead of
+  waiting out a TTL (LoadBucketMetadata / LoadUser / LoadPolicy RPCs in
+  the reference);
+- **introspection**: per-node server info and the node's local lock
+  table, aggregated by the admin API (ServerInfo / GetLocks);
+- **bootstrap**: before joining, a node compares its config fingerprint
+  (version + endpoint topology + credential hash) against every peer and
+  refuses to proceed on mismatch (verifyServerSystemConfig,
+  bootstrap-peer-server.go:109 - catches the classic "one node started
+  with different creds/drive order" operator error).
+
+Notifications are fire-and-forget fan-out: a dead peer misses the push
+but converges via its cache TTL - the same weak consistency the
+reference accepts (peer-rest-client.go swallows notification errors).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import hashlib
+import http.client
+import os
+import threading
+import time
+
+import msgpack
+
+from ..utils import jwt
+
+PREFIX = "/minio-tpu/peer/v1"
+_TOKEN_TTL_S = 900
+VERSION = "minio-tpu/1"  # bumped on wire-format changes
+
+
+class PeerAuthError(ConnectionError):
+    """Peer rejected our internode JWT (mismatched secret key)."""
+
+
+def _q1(q: dict, key: str) -> str:
+    """First query value (the internode router hands parse_qs lists)."""
+    v = q.get(key, "")
+    if isinstance(v, (list, tuple)):
+        v = v[0] if v else ""
+    return v
+
+
+def _pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _unpack(raw: bytes):
+    return msgpack.unpackb(raw, raw=False) if raw else None
+
+
+def cluster_fingerprint(
+    zone_args: "list[str]", access_key: str, secret_key: str
+) -> dict:
+    """What every node must agree on to form one cluster
+    (getServerSystemCfg: endpoints + credentials + platform).
+
+    Credentials are compared as a salted hash so the handshake never
+    moves secrets; the topology is the sorted raw endpoint args, which
+    all nodes share verbatim in distributed mode.
+    """
+    cred = hashlib.sha256(
+        f"{access_key}\x00{secret_key}".encode()
+    ).hexdigest()[:32]
+    return {
+        "version": VERSION,
+        "endpoints": sorted(zone_args),
+        "cred_hash": cred,
+    }
+
+
+class PeerRESTServer:
+    """Serves this node's control RPCs (peer-rest-server.go)."""
+
+    def __init__(
+        self,
+        s3server,
+        secret: str,
+        fingerprint: "dict | None" = None,
+        local_locker=None,
+    ):
+        self.s3 = s3server
+        self._secret = secret
+        self.fingerprint = fingerprint or {}
+        self.local_locker = local_locker
+        self.started = time.time()
+
+    # -- RPC implementations ---------------------------------------------
+
+    def _health(self, q, body) -> dict:
+        return {
+            "ok": True,
+            "initialized": self.s3.object_layer is not None,
+        }
+
+    def _server_info(self, q, body) -> dict:
+        """Per-node info (madmin ServerProperties shape, trimmed)."""
+        info = {
+            "endpoint": self.s3.endpoint,
+            "version": VERSION,
+            "uptime_s": round(time.time() - self.started, 1),
+            "state": (
+                "online" if self.s3.object_layer is not None
+                else "initializing"
+            ),
+            "pid": os.getpid(),
+        }
+        ol = self.s3.object_layer
+        if ol is not None:
+            try:
+                si = ol.storage_info()
+                # zones layer nests per-zone dicts; a bare set is flat
+                zones = si.get("zones", [si])
+                info["drives_online"] = sum(z.get("online", 0) for z in zones)
+                info["drives"] = sum(z.get("disks", 0) for z in zones)
+            except Exception:  # noqa: BLE001
+                pass
+        return info
+
+    def _load_bucket_metadata(self, q, body) -> dict:
+        bucket = _q1(q, "bucket")
+        if bucket and self.s3.object_layer is not None:
+            self.s3.bucket_meta.invalidate(bucket)
+        return {"ok": True}
+
+    def _delete_bucket_metadata(self, q, body) -> dict:
+        bucket = _q1(q, "bucket")
+        if bucket and self.s3.object_layer is not None:
+            self.s3.bucket_meta.invalidate(bucket)
+        return {"ok": True}
+
+    def _load_iam(self, q, body) -> dict:
+        iam = getattr(self.s3, "iam", None)
+        if iam is not None:
+            iam.refresh()
+        return {"ok": True}
+
+    def _get_locks(self, q, body) -> dict:
+        if self.local_locker is None:
+            return {"locks": []}
+        return {"locks": self.local_locker.dump()}
+
+    def _verify_config(self, q, body) -> dict:
+        """Bootstrap handshake: peer sends ITS fingerprint; we diff
+        against ours field by field (bootstrap-peer-server.go:78-107)."""
+        theirs = _unpack(body) or {}
+        mism = [
+            k
+            for k in ("version", "endpoints", "cred_hash")
+            if theirs.get(k) != self.fingerprint.get(k)
+        ]
+        if mism:
+            return {"ok": False, "mismatch": mism}
+        return {"ok": True}
+
+    _METHODS = {
+        "health": _health,
+        "serverinfo": _server_info,
+        "loadbucketmetadata": _load_bucket_metadata,
+        "deletebucketmetadata": _delete_bucket_metadata,
+        "loadiam": _load_iam,
+        "getlocks": _get_locks,
+        "verifyconfig": _verify_config,
+    }
+
+    # -- dispatch (internode-plane calling convention) --------------------
+
+    def handle(
+        self,
+        method_name: str,
+        query: dict,
+        body: bytes,
+        headers: "dict | None" = None,
+    ) -> tuple[int, bytes, dict]:
+        try:
+            authz = {
+                k.lower(): v for k, v in (headers or {}).items()
+            }.get("authorization", "")
+            if not authz.startswith("Bearer "):
+                raise jwt.JWTError("missing bearer token")
+            jwt.verify(authz[len("Bearer ") :], self._secret)
+        except Exception as e:  # noqa: BLE001
+            return 401, _pack(str(e)), {}
+        fn = self._METHODS.get(method_name)
+        if fn is None:
+            return 400, _pack(f"unknown method {method_name}"), {}
+        try:
+            return 200, _pack(fn(self, query, body)), {}
+        except Exception as e:  # noqa: BLE001
+            return 500, _pack(str(e)), {}
+
+
+class PeerRESTClient:
+    """Control-plane client for one peer node (peer-rest-client.go)."""
+
+    def __init__(
+        self, host: str, port: int, secret: str, timeout: float = 5.0
+    ):
+        self.host = host
+        self.port = port
+        self._secret = secret
+        self._timeout = timeout
+        self._local = threading.local()
+        self._token = ""
+        self._token_exp = 0.0
+
+    def _bearer(self) -> str:
+        now = time.time()
+        if now > self._token_exp - 60:
+            self._token = jwt.sign(
+                {"sub": "minio-tpu-peer"}, self._secret, _TOKEN_TTL_S
+            )
+            self._token_exp = now + _TOKEN_TTL_S
+        return self._token
+
+    def _conn(self) -> http.client.HTTPConnection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = http.client.HTTPConnection(
+                self.host, self.port, timeout=self._timeout
+            )
+            self._local.conn = c
+        return c
+
+    def _drop_conn(self) -> None:
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._local.conn = None
+
+    def call(
+        self,
+        method: str,
+        query: "dict | None" = None,
+        doc=None,
+        retry: bool = True,
+    ):
+        """One RPC; raises ConnectionError on transport failure and
+        PeerAuthError on a 401.  Peer methods are idempotent so a retry
+        on a fresh connection is safe - but fire-and-forget callers pass
+        retry=False so a down peer costs one timeout, not two."""
+        import urllib.parse
+
+        body = _pack(doc) if doc is not None else b""
+        headers = {
+            "Authorization": f"Bearer {self._bearer()}",
+            "Content-Length": str(len(body)),
+        }
+        url = f"{PREFIX}/{method}"
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        attempts = (0, 1) if retry else (0,)
+        for attempt in attempts:
+            conn = self._conn()
+            try:
+                conn.request("POST", url, body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                break
+            except (OSError, http.client.HTTPException):
+                self._drop_conn()
+                if attempt == attempts[-1]:
+                    raise ConnectionError(
+                        f"peer {self.host}:{self.port} unreachable"
+                    ) from None
+        if resp.status == 401:
+            # credential mismatch, NOT a transport problem: the
+            # bootstrap handshake must treat this as fatal, not retry
+            raise PeerAuthError(
+                f"peer {self.host}:{self.port} rejected the internode "
+                "token - differing credentials?"
+            )
+        if resp.status != 200:
+            raise ConnectionError(
+                f"peer {self.host}:{self.port}: HTTP {resp.status} "
+                f"{_unpack(payload)!r}"
+            )
+        return _unpack(payload)
+
+    # -- typed wrappers ---------------------------------------------------
+
+    def health(self) -> dict:
+        return self.call("health")
+
+    def server_info(self) -> dict:
+        return self.call("serverinfo")
+
+    def load_bucket_metadata(self, bucket: str) -> None:
+        self.call("loadbucketmetadata", {"bucket": bucket}, retry=False)
+
+    def delete_bucket_metadata(self, bucket: str) -> None:
+        self.call("deletebucketmetadata", {"bucket": bucket}, retry=False)
+
+    def load_iam(self) -> None:
+        self.call("loadiam", retry=False)
+
+    def get_locks(self) -> list:
+        return self.call("getlocks").get("locks", [])
+
+    def verify_config(self, fingerprint: dict) -> dict:
+        return self.call("verifyconfig", doc=fingerprint)
+
+    def is_online(self) -> bool:
+        try:
+            return bool(self.health().get("ok"))
+        except Exception:  # noqa: BLE001
+            return False
+
+    def close(self) -> None:
+        self._drop_conn()
+
+
+class PeerNotifier:
+    """Fire-and-forget fan-out to every peer (the NotificationSys
+    front half, cmd/notification.go: load/delete broadcasts).
+
+    Pushes run on a small pool so a hung peer cannot stall the S3
+    request that triggered the notification; failures are dropped - the
+    peer's cache TTL is the convergence backstop.
+    """
+
+    def __init__(self, clients: "list[PeerRESTClient]"):
+        self.clients = clients
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, min(8, len(clients) or 1)),
+            thread_name_prefix="peer-notify",
+        )
+
+    def _fanout(self, fn) -> None:
+        for c in self.clients:
+            self._pool.submit(self._quiet, fn, c)
+
+    @staticmethod
+    def _quiet(fn, client) -> None:
+        try:
+            fn(client)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def bucket_meta_changed(self, bucket: str) -> None:
+        self._fanout(lambda c: c.load_bucket_metadata(bucket))
+
+    def bucket_meta_deleted(self, bucket: str) -> None:
+        self._fanout(lambda c: c.delete_bucket_metadata(bucket))
+
+    def iam_changed(self) -> None:
+        self._fanout(lambda c: c.load_iam())
+
+    def _gather(self, fn, fallback):
+        """Query every peer concurrently on the pool: the wall time for
+        an admin aggregation is ONE peer's timeout, not the sum over
+        every down node."""
+        futs = [self._pool.submit(fn, c) for c in self.clients]
+        out = []
+        for c, f in zip(self.clients, futs):
+            try:
+                out.append(f.result())
+            except Exception:  # noqa: BLE001
+                out.append(fallback(c))
+        return out
+
+    def server_infos(self) -> "list[dict]":
+        """Concurrent gather (admin ServerInfo aggregation)."""
+        return self._gather(
+            lambda c: c.server_info(),
+            lambda c: {"endpoint": f"{c.host}:{c.port}", "state": "offline"},
+        )
+
+    def all_locks(self) -> "list[list]":
+        return self._gather(lambda c: c.get_locks(), lambda c: [])
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        for c in self.clients:
+            c.close()
+
+
+def verify_cluster(
+    clients: "list[PeerRESTClient]",
+    fingerprint: dict,
+    timeout_s: float = 60.0,
+    interval_s: float = 0.5,
+) -> None:
+    """Boot-time handshake: block until every peer answers verifyconfig
+    with ok, raising on fingerprint mismatch (waitForInitConfigs /
+    verifyServerSystemConfig semantics: unreachable peers are retried -
+    they may simply not be up yet - but a REACHABLE disagreeing peer is
+    a fatal operator error)."""
+    deadline = time.monotonic() + timeout_s
+    pending = list(clients)
+    while pending:
+        still = []
+        for c in pending:
+            try:
+                res = c.verify_config(fingerprint)
+            except PeerAuthError as e:
+                # a REACHABLE peer rejecting our token means the nodes
+                # were started with different secret keys - fatal now,
+                # not after a full timeout of retries
+                raise RuntimeError(
+                    f"{e} - check that every node was started with "
+                    "identical credentials"
+                ) from None
+            except ConnectionError:
+                still.append(c)  # not up yet
+                continue
+            if not res.get("ok"):
+                raise RuntimeError(
+                    f"peer {c.host}:{c.port} disagrees on cluster config "
+                    f"(mismatched: {res.get('mismatch')}) - check that "
+                    "every node was started with identical credentials "
+                    "and endpoint arguments"
+                )
+        pending = still
+        if pending and time.monotonic() > deadline:
+            names = [f"{c.host}:{c.port}" for c in pending]
+            raise RuntimeError(
+                f"bootstrap handshake timed out waiting for {names}"
+            )
+        if pending:
+            time.sleep(interval_s)

@@ -129,6 +129,32 @@ class LocalLocker:
     def is_online(self) -> bool:
         return True
 
+    def dump(self) -> "list[dict]":
+        """Snapshot of held locks (admin top-locks / peer GetLocks).
+
+        Entries carry this node's endpoint and WALL-clock acquisition
+        time (internal timestamps are monotonic, which would be
+        incomparable across processes when the admin API aggregates
+        every node's dump)."""
+        now_mono = time.monotonic()
+        now_wall = time.time()
+        with self._mu:
+            return [
+                {
+                    "endpoint": self.endpoint,
+                    "resource": r,
+                    "uid": e.uid,
+                    "writer": e.writer,
+                    "source": e.source,
+                    "age_s": round(now_mono - e.acquired_at, 3),
+                    "acquired_at": round(
+                        now_wall - (now_mono - e.acquired_at), 3
+                    ),
+                }
+                for r, entries in self._locks.items()
+                for e in entries
+            ]
+
     def close(self) -> None:
         pass
 

@@ -64,6 +64,9 @@ class IAMSys:
         #               "parent": str (service accounts)}
         self._users: "dict[str, dict]" = {}
         self._policies: "dict[str, Policy]" = dict(CANNED_POLICIES)
+        # peer control plane: set in distributed mode so IAM edits
+        # broadcast a reload to every node
+        self.notifier = None
         if object_layer is not None:
             self.refresh()
 
@@ -82,6 +85,8 @@ class IAMSys:
             io.BytesIO(raw),
             len(raw),
         )
+        if self.notifier is not None:
+            self.notifier.iam_changed()
 
     def _delete_doc(self, kind: str, name: str) -> None:
         if self._ol is None:
@@ -92,6 +97,8 @@ class IAMSys:
             )
         except ObjectNotFound:
             pass
+        if self.notifier is not None:
+            self.notifier.iam_changed()
 
     def _load_docs(self, kind: str) -> "dict[str, dict]":
         out: dict = {}
@@ -116,6 +123,24 @@ class IAMSys:
             if not res.is_truncated:
                 return out
             marker = res.next_marker
+
+    def start_refresher(self, interval_s: float = 120.0):
+        """Periodic reload fallback (iam.go watch loop): peer
+        notifications give immediate convergence; this catches any a
+        down node missed.  Daemon thread; returns it."""
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.refresh()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        t = threading.Thread(target=loop, daemon=True, name="iam-refresh")
+        t.stop = stop  # type: ignore[attr-defined]
+        t.start()
+        return t
 
     def refresh(self) -> None:
         """Reload users + policies from the store (iam.go Load)."""

@@ -75,11 +75,22 @@ class BucketMetadata:
 class BucketMetadataSys:
     """Read-through cache over the persisted per-bucket documents."""
 
-    def __init__(self, object_layer, cache_ttl_s: float = CACHE_TTL_S):
+    def __init__(self, object_layer, cache_ttl_s: "float | None" = None):
+        import os
+
         self._ol = object_layer
-        self._ttl = cache_ttl_s
+        self._ttl = (
+            cache_ttl_s
+            if cache_ttl_s is not None
+            else float(
+                os.environ.get("MINIO_TPU_BUCKET_META_TTL_S") or CACHE_TTL_S
+            )
+        )
         self._mu = threading.RLock()
         self._cache: "dict[str, tuple[BucketMetadata, float]]" = {}
+        # peer control plane: set in distributed mode so edits broadcast
+        # an invalidation instead of waiting out peers' TTLs
+        self.notifier = None
 
     def _path(self, bucket: str) -> str:
         return f"{META_PREFIX}/{bucket}/metadata.json"
@@ -130,7 +141,9 @@ class BucketMetadataSys:
                 META_BUCKET, self._path(bucket), io.BytesIO(raw), len(raw)
             )
             self._cache[bucket] = (bm, time.monotonic())
-            return bm
+        if self.notifier is not None:
+            self.notifier.bucket_meta_changed(bucket)
+        return bm
 
     def delete(self, bucket: str) -> None:
         """Drop the document when its bucket is deleted."""
@@ -140,6 +153,8 @@ class BucketMetadataSys:
             self._ol.delete_object(META_BUCKET, self._path(bucket))
         except (ObjectNotFound, BucketNotFound):
             pass
+        if self.notifier is not None:
+            self.notifier.bucket_meta_deleted(bucket)
 
     def invalidate(self, bucket: "str | None" = None) -> None:
         """Forget cached entries (peer-invalidation stand-in)."""

@@ -269,8 +269,38 @@ def main(argv=None) -> int:
         from ..dsync.lock_rest import PREFIX as LOCK_PREFIX
 
         srv.register_internode(LOCK_PREFIX, lock_rest.handle)
+
+    # peer control plane + bootstrap handshake (distributed mode):
+    # every node serves /minio-tpu/peer/v1 and verifies the cluster
+    # config fingerprint against every peer before joining
+    from ..cluster import peer as peer_mod
+
+    fingerprint = peer_mod.cluster_fingerprint(
+        args.zones, args.access_key, args.secret_key
+    )
+    peers = [
+        peer_mod.PeerRESTClient(host, port, args.secret_key)
+        for host, port, is_local in cluster_nodes(args.zones, local_port)
+        if not is_local
+    ]
+    peer_rest = peer_mod.PeerRESTServer(
+        srv,
+        args.secret_key,
+        fingerprint=fingerprint,
+        local_locker=lock_rest.locker if lock_rest is not None else None,
+    )
+    srv.register_internode(peer_mod.PREFIX, peer_rest.handle)
+    srv.local_locker = lock_rest.locker if lock_rest is not None else None
+    if peers:
+        srv.peer_notifier = peer_mod.PeerNotifier(peers)
+
     srv.start()
     print(f"minio-tpu listening at {srv.endpoint} (bootstrapping)")
+    if peers:
+        peer_mod.verify_cluster(
+            peers, fingerprint, timeout_s=args.format_timeout
+        )
+        print(f"bootstrap handshake ok with {len(peers)} peer(s)")
 
     ol, _ = build_cluster(
         args.zones,
@@ -285,9 +315,12 @@ def main(argv=None) -> int:
     # store-backed IAM after the object layer is up (iam.go:419 Init)
     from ..iam.sys import IAMSys
 
-    srv.attach_iam(
-        IAMSys(args.access_key, args.secret_key, ol)
-    )
+    iam = IAMSys(args.access_key, args.secret_key, ol)
+    srv.attach_iam(iam)
+    if peers:
+        iam.start_refresher(
+            float(os.environ.get("MINIO_TPU_IAM_REFRESH_S") or 120.0)
+        )
     _heal_routine, _disk_monitor = start_background_heal(ol)
     srv.heal_routine = _heal_routine
     srv.heal_queue = _heal_routine.queue

@@ -42,6 +42,8 @@ class AdminAPI:
             return 200, _json(ol.storage_info())
         if route == ("POST", "heal"):
             return 200, self._heal(ol, q)
+        if route == ("GET", "top-locks"):
+            return 200, self._top_locks()
         # IAM management
         iam = self.s3.iam
         if route == ("GET", "list-users"):
@@ -111,15 +113,33 @@ class AdminAPI:
                 )
             except Exception:  # noqa: BLE001
                 disks.append({"state": "offline"})
-        return _json(
-            {
-                "version": VERSION,
-                "uptime_seconds": round(time.time() - _START, 1),
-                "mode": "erasure",
-                "storage": si,
-                "disks": disks,
-            }
-        )
+        doc = {
+            "version": VERSION,
+            "uptime_seconds": round(time.time() - _START, 1),
+            "mode": "erasure",
+            "storage": si,
+            "disks": disks,
+        }
+        # distributed mode: one entry per peer via the control plane
+        # (madmin ServerInfo aggregates every node)
+        notifier = getattr(self.s3, "peer_notifier", None)
+        if notifier is not None:
+            doc["mode"] = "distributed"
+            doc["nodes"] = notifier.server_infos()
+        return _json(doc)
+
+    def _top_locks(self) -> bytes:
+        """Held locks across the cluster (madmin TopLocks): this
+        node's local locker plus every peer's via the control plane."""
+        locks: list = []
+        local = getattr(self.s3, "local_locker", None)
+        if local is not None:
+            locks.extend(local.dump())
+        notifier = getattr(self.s3, "peer_notifier", None)
+        if notifier is not None:
+            for node_locks in notifier.all_locks():
+                locks.extend(node_locks)
+        return _json({"locks": locks})
 
     def _heal(self, ol, q: "dict[str, str]") -> bytes:
         bucket = q.get("bucket", "")

@@ -161,6 +161,9 @@ class S3Server:
         )
         self.heal_routine = None  # attached by the server main
         self.heal_queue = None
+        # peer control plane (distributed mode): PeerNotifier fanning
+        # out cache invalidations + aggregating node info
+        self.peer_notifier = None
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         # internode planes (storage/lock/peer/bootstrap REST, the
@@ -173,6 +176,7 @@ class S3Server:
         """Swap in a store-backed IAMSys once the object layer is up
         (startBackgroundIAMLoad ordering, server-main.go:529)."""
         self.iam = iam
+        iam.notifier = self.peer_notifier
         self.verifier = SigV4Verifier(iam.lookup_secret, self.region)
 
     def register_internode(self, prefix: str, handler) -> None:
@@ -188,6 +192,7 @@ class S3Server:
             or self._bucket_meta._ol is not self.object_layer
         ):
             self._bucket_meta = BucketMetadataSys(self.object_layer)
+            self._bucket_meta.notifier = self.peer_notifier
         return self._bucket_meta
 
     # -- lifecycle --------------------------------------------------------

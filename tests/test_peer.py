@@ -1,0 +1,284 @@
+"""Peer REST control plane + bootstrap handshake
+(cmd/peer-rest-server.go, cmd/peer-rest-client.go,
+cmd/bootstrap-peer-server.go).
+"""
+
+import io
+import json
+import time
+
+import pytest
+
+from minio_tpu.cluster import peer as peer_mod
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+SECRET = "peer-secret"
+BLOCK = 64 << 10
+
+
+def _layer(root, n=4):
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(n)]
+    return ErasureObjects(disks, block_size=BLOCK)
+
+
+def _node(tmp_path, name, fingerprint=None):
+    """One in-process 'node': S3Server + its peer plane on the
+    internode listener, over its own disk set."""
+    ol = _layer(tmp_path / name)
+    srv = S3Server(
+        ol, address="127.0.0.1:0", internode_secret=SECRET,
+        secret_key=SECRET,
+    )
+    peer_rest = peer_mod.PeerRESTServer(
+        srv, SECRET, fingerprint=fingerprint or {}
+    )
+    srv.register_internode(peer_mod.PREFIX, peer_rest.handle)
+    srv.start()
+    return srv
+
+
+def _client(srv) -> peer_mod.PeerRESTClient:
+    hostport = srv.endpoint.split("//", 1)[-1]
+    host, port = hostport.rsplit(":", 1)
+    return peer_mod.PeerRESTClient(host, int(port), SECRET)
+
+
+def test_health_and_server_info(tmp_path):
+    srv = _node(tmp_path, "a")
+    try:
+        c = _client(srv)
+        h = c.health()
+        assert h == {"ok": True, "initialized": True}
+        info = c.server_info()
+        assert info["state"] == "online"
+        assert info["endpoint"] == srv.endpoint
+        assert info["drives"] == 4
+    finally:
+        srv.shutdown()
+
+
+def test_auth_required(tmp_path):
+    srv = _node(tmp_path, "a")
+    try:
+        host, port = srv.endpoint.rsplit(":", 1)
+        bad = peer_mod.PeerRESTClient(host, int(port), "wrong-secret")
+        with pytest.raises(ConnectionError):
+            bad.health()
+        assert not bad.is_online()
+    finally:
+        srv.shutdown()
+
+
+def test_bucket_metadata_invalidation(tmp_path):
+    """The core invalidation flow: node B has a cached (stale) bucket
+    document; the peer RPC makes its next read go back to the store."""
+    srv = _node(tmp_path, "a")
+    try:
+        srv.object_layer.make_bucket("bkt1")
+        # B-side cache would never expire on its own
+        srv.bucket_meta._ttl = 3600.0
+        assert srv.bucket_meta.get("bkt1").versioning == ""
+        # another node writes the document directly through the layer
+        # (bypassing this node's cache, like a remote update would)
+        import dataclasses
+
+        bm = dataclasses.replace(
+            srv.bucket_meta.get("bkt1"), name="bkt1", versioning="Enabled"
+        )
+        raw = json.dumps(bm.to_dict()).encode()
+        srv.object_layer.put_object(
+            ".sys", "buckets/bkt1/metadata.json", io.BytesIO(raw), len(raw)
+        )
+        # cache still serves the stale doc
+        assert srv.bucket_meta.get("bkt1").versioning == ""
+        # the peer RPC invalidates -> next read sees the new doc
+        _client(srv).load_bucket_metadata("bkt1")
+        assert srv.bucket_meta.get("bkt1").versioning == "Enabled"
+    finally:
+        srv.shutdown()
+
+
+def test_iam_reload(tmp_path):
+    from minio_tpu.iam.sys import IAMSys
+
+    srv = _node(tmp_path, "a")
+    try:
+        iam = IAMSys("root", SECRET, srv.object_layer)
+        srv.attach_iam(iam)
+        # a 'remote' IAMSys over the same store adds a user
+        other = IAMSys("root", SECRET, srv.object_layer)
+        other.add_user("alice", "alice-secret-key", "readonly")
+        assert iam.lookup_secret("alice") is None  # not loaded yet
+        _client(srv).load_iam()
+        assert iam.lookup_secret("alice") == "alice-secret-key"
+    finally:
+        srv.shutdown()
+
+
+def test_notifier_fanout(tmp_path):
+    """BucketMetadataSys.update on node A pushes invalidation to B."""
+    fp = peer_mod.cluster_fingerprint(["x"], "k", "s")
+    a = _node(tmp_path, "a", fp)
+    b = _node(tmp_path, "b", fp)
+    try:
+        # both nodes over the SAME store: reuse A's object layer on B
+        b.object_layer = a.object_layer
+        b._bucket_meta = None  # rebind to the shared layer
+        a.object_layer.make_bucket("shared")
+        b.bucket_meta._ttl = 3600.0
+        a.bucket_meta._ttl = 3600.0
+        assert b.bucket_meta.get("shared").versioning == ""
+        # wire A's notifier at B
+        a.bucket_meta.notifier = peer_mod.PeerNotifier([_client(b)])
+        a.bucket_meta.update("shared", versioning="Enabled")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if b.bucket_meta.get("shared").versioning == "Enabled":
+                break
+            time.sleep(0.05)
+        assert b.bucket_meta.get("shared").versioning == "Enabled"
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_bootstrap_handshake(tmp_path):
+    fp = peer_mod.cluster_fingerprint(
+        ["http://h{1...2}/d{1...4}"], "ak", "sk"
+    )
+    srv = _node(tmp_path, "a", fingerprint=fp)
+    try:
+        c = _client(srv)
+        # agreeing node passes
+        peer_mod.verify_cluster([c], dict(fp), timeout_s=5)
+        # wrong credentials are fatal, not retried
+        bad = peer_mod.cluster_fingerprint(
+            ["http://h{1...2}/d{1...4}"], "ak", "DIFFERENT"
+        )
+        with pytest.raises(RuntimeError, match="cred_hash"):
+            peer_mod.verify_cluster([c], bad, timeout_s=5)
+        # wrong topology too
+        bad2 = peer_mod.cluster_fingerprint(["http://other/d"], "ak", "sk")
+        with pytest.raises(RuntimeError, match="endpoints"):
+            peer_mod.verify_cluster([c], bad2, timeout_s=5)
+    finally:
+        srv.shutdown()
+
+
+def test_handshake_waits_for_unreachable_peer(tmp_path):
+    c = peer_mod.PeerRESTClient("127.0.0.1", 1, SECRET, timeout=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="timed out"):
+        peer_mod.verify_cluster([c], {}, timeout_s=1.0, interval_s=0.1)
+    assert time.monotonic() - t0 >= 0.9  # it retried, not failed fast
+
+
+def test_get_locks(tmp_path):
+    from minio_tpu.dsync.drwmutex import LockArgs
+    from minio_tpu.dsync.local_locker import LocalLocker
+
+    ol = _layer(tmp_path / "a")
+    srv = S3Server(
+        ol, address="127.0.0.1:0", internode_secret=SECRET,
+        secret_key=SECRET,
+    )
+    locker = LocalLocker("n1")
+    locker.lock(LockArgs(uid="u1", resources=("bkt/obj",), source="t"))
+    peer_rest = peer_mod.PeerRESTServer(srv, SECRET, local_locker=locker)
+    srv.register_internode(peer_mod.PREFIX, peer_rest.handle)
+    srv.start()
+    try:
+        locks = _client(srv).get_locks()
+        assert len(locks) == 1
+        assert locks[0]["resource"] == "bkt/obj"
+        assert locks[0]["writer"] is True
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_cross_node_config_propagation(tmp_path):
+    """e2e over two REAL server processes: a bucket policy set through
+    node 1 takes effect on node 2 via the peer plane - the bucket-meta
+    TTL is cranked to an hour so ONLY the control-plane push can
+    propagate it."""
+    import json as jsonmod
+    import sys
+    import urllib.error
+    import urllib.request
+
+    sys.path.insert(0, "tests")
+    import test_distributed as td
+    from s3client import S3Client
+
+    ports = [td._free_port(), td._free_port()]
+    procs, _ = td._spawn_cluster(
+        tmp_path, ports, {"MINIO_TPU_BUCKET_META_TTL_S": "3600"}
+    )
+    try:
+        for port in ports:
+            td._wait_ready(procs, port)
+        c1 = S3Client(f"http://127.0.0.1:{ports[0]}")
+        assert c1.make_bucket("cfg").status == 200
+        assert c1.put_object("cfg", "pub.txt", b"hello peers").status == 200
+
+        def anon_get(port):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/cfg/pub.txt", timeout=5
+                ) as r:
+                    return r.status, r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, b""
+
+        # prime node 2's cache: anonymous is denied pre-policy
+        assert anon_get(ports[1])[0] == 403
+        policy = jsonmod.dumps(
+            {
+                "Version": "2012-10-17",
+                "Statement": [
+                    {
+                        "Effect": "Allow",
+                        "Principal": "*",
+                        "Action": "s3:GetObject",
+                        "Resource": "arn:aws:s3:::cfg/*",
+                    }
+                ],
+            }
+        ).encode()
+        r = c1.request("PUT", "/cfg", query={"policy": ""}, body=policy)
+        assert r.status in (200, 204), (r.status, r.body)
+        # node 2 must pick it up via the peer push (TTL would take 1h)
+        deadline = time.time() + 15
+        status = None
+        while time.time() < deadline:
+            status, body = anon_get(ports[1])
+            if status == 200:
+                assert body == b"hello peers"
+                break
+            time.sleep(0.25)
+        assert status == 200, f"policy never propagated (last {status})"
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+                pr.wait(timeout=10)
+
+
+def test_handshake_fatal_on_wrong_secret(tmp_path):
+    """A REACHABLE peer rejecting the internode token (different
+    --secret-key) must fail the handshake immediately, not hang until
+    the timeout."""
+    srv = _node(tmp_path, "a")
+    try:
+        hostport = srv.endpoint.split("//", 1)[-1]
+        host, port = hostport.rsplit(":", 1)
+        bad = peer_mod.PeerRESTClient(host, int(port), "other-secret")
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="credentials"):
+            peer_mod.verify_cluster([bad], {}, timeout_s=30)
+        assert time.monotonic() - t0 < 5  # failed fast, no retry spin
+    finally:
+        srv.shutdown()
