@@ -135,12 +135,14 @@ class PeerRESTServer:
         bucket = _q1(q, "bucket")
         if bucket and self.s3.object_layer is not None:
             self.s3.bucket_meta.invalidate(bucket)
+            self.s3.invalidate_event_rules(bucket)
         return {"ok": True}
 
     def _delete_bucket_metadata(self, q, body) -> dict:
         bucket = _q1(q, "bucket")
         if bucket and self.s3.object_layer is not None:
             self.s3.bucket_meta.invalidate(bucket)
+            self.s3.invalidate_event_rules(bucket)
         return {"ok": True}
 
     def _load_iam(self, q, body) -> dict:

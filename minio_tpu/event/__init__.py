@@ -1,0 +1,14 @@
+"""Bucket event notification subsystem (pkg/event, ~7.9k LoC in the
+reference: pkg/event/event.go name masks, pkg/event/rules.go,
+pkg/event/targetlist.go; wired by cmd/notification.go and
+cmd/bucket-notification-handlers.go)."""
+
+from .event import Event, EventName, Identity  # noqa: F401
+from .notifier import EventNotifier  # noqa: F401
+from .rules import NotificationConfig, RulesMap  # noqa: F401
+from .targets import (  # noqa: F401
+    LogFileTarget,
+    MemoryTarget,
+    WebhookTarget,
+    targets_from_env,
+)

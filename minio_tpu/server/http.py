@@ -164,6 +164,12 @@ class S3Server:
         # peer control plane (distributed mode): PeerNotifier fanning
         # out cache invalidations + aggregating node info
         self.peer_notifier = None
+        # bucket event notifications (pkg/event): targets from env,
+        # rules loaded lazily per bucket from the metadata subsystem
+        from ..event import EventNotifier, targets_from_env
+
+        self.events = EventNotifier(targets_from_env()).start()
+        self._event_rules_loaded: "set[str]" = set()
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         # internode planes (storage/lock/peer/bootstrap REST, the
@@ -182,6 +188,30 @@ class S3Server:
     def register_internode(self, prefix: str, handler) -> None:
         """Mount an internode REST plane under a path prefix."""
         self.internode[prefix] = handler
+
+    def ensure_event_rules(self, bucket: str) -> None:
+        """Lazily hydrate a bucket's notification rules from the
+        persisted document (bucketRulesMap load, notification.go)."""
+        if bucket in self._event_rules_loaded or self.object_layer is None:
+            return
+        try:
+            raw = self.bucket_meta.get(bucket).notification_xml
+        except Exception:  # noqa: BLE001
+            # transient metadata-read failure: do NOT mark loaded, so
+            # the next event retries instead of dropping forever
+            return
+        try:
+            self.events.load_bucket_config(bucket, raw)
+        except Exception:  # noqa: BLE001 - bad persisted doc: no rules
+            pass
+        self._event_rules_loaded.add(bucket)
+
+    def mark_event_rules_loaded(self, bucket: str) -> None:
+        self._event_rules_loaded.add(bucket)
+
+    def invalidate_event_rules(self, bucket: str) -> None:
+        """Peer invalidation path: re-read the config on next event."""
+        self._event_rules_loaded.discard(bucket)
 
     @property
     def bucket_meta(self) -> BucketMetadataSys:
@@ -219,6 +249,7 @@ class S3Server:
             self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        self.events.shutdown()
 
     @property
     def endpoint(self) -> str:
@@ -737,6 +768,8 @@ class _Handler(BaseHTTPRequestHandler):
                     + xmlr.S3_NS.encode()
                     + b'">' + inner + b"</VersioningConfiguration>",
                 )
+            if "notification" in query:
+                return self._get_bucket_notification(bucket)
             return self._list_objects(bucket, query)
         if m == "HEAD":
             ol.get_bucket_info(bucket)
@@ -748,6 +781,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._put_bucket_versioning(
                     bucket, self._read_body()
                 )
+            if "notification" in query:
+                return self._put_bucket_notification(
+                    bucket, self._read_body()
+                )
             ol.make_bucket(bucket)
             return self._respond(200, headers={"Location": f"/{bucket}"})
         if m == "DELETE":
@@ -757,6 +794,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._respond(204)
             ol.delete_bucket(bucket)
             self.s3.bucket_meta.delete(bucket)
+            # a recreated bucket must not inherit the old rules
+            self.s3.events.remove_bucket(bucket)
+            self.s3.invalidate_event_rules(bucket)
             return self._respond(204)
         if m == "POST":
             if "delete" in query:
@@ -892,6 +932,62 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self._respond(204)
 
+    # -- bucket notification (bucket-notification-handlers.go) ------------
+
+    def _get_bucket_notification(self, bucket: str):
+        self.s3.object_layer.get_bucket_info(bucket)
+        raw = self.s3.bucket_meta.get(bucket).notification_xml
+        if raw:
+            return self._respond(200, raw.encode())
+        from ..event.rules import NotificationConfig
+
+        self._respond(200, NotificationConfig().to_xml())
+
+    def _put_bucket_notification(self, bucket: str, body: bytes):
+        from ..event.rules import NotificationConfig, NotificationError
+
+        self.s3.object_layer.get_bucket_info(bucket)
+        try:
+            cfg = NotificationConfig.from_xml(body)
+            # validates ARNs against registered targets AND installs
+            # the rules (config.Validate + bucketRulesMap update)
+            self.s3.events.set_bucket_config(bucket, cfg)
+        except NotificationError as e:
+            raise S3Error("InvalidArgument", str(e)) from None
+        self.s3.bucket_meta.update(
+            bucket, notification_xml=cfg.to_xml().decode()
+        )
+        self.s3.mark_event_rules_loaded(bucket)
+        self._respond(200)
+
+    def _notify(
+        self, name, bucket, key, etag="", size=0, version_id=""
+    ) -> None:
+        """Queue a bucket event (sendEvent, cmd/notification.go) -
+        O(1) when the bucket has no notification rules."""
+        s3 = self.s3
+        s3.ensure_event_rules(bucket)
+        if not s3.events.rules.has_rules(bucket):
+            return
+        from ..event import Event, Identity
+
+        ctx = self._auth
+        s3.events.send(
+            Event(
+                name=name,
+                bucket=bucket,
+                object_key=key,
+                etag=etag,
+                size=size,
+                version_id=version_id,
+                identity=Identity(
+                    "" if ctx is None or ctx.anonymous else ctx.access_key,
+                    self.client_address[0] if self.client_address else "",
+                ),
+                endpoint=s3.endpoint,
+            )
+        )
+
     def _delete_multiple(self, bucket: str, body: bytes):
         try:
             root = ET.fromstring(body)
@@ -916,9 +1012,17 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 # a named version is removed outright; an unqualified
                 # delete on a versioned bucket writes a marker
-                self.s3.object_layer.delete_object(
+                dinfo = self.s3.object_layer.delete_object(
                     bucket, key, vid,
                     versioned=versioned, version_suspended=suspended,
+                )
+                from ..event.event import EventName
+
+                self._notify(
+                    EventName.OBJECT_REMOVED_DELETE_MARKER
+                    if dinfo.delete_marker
+                    else EventName.OBJECT_REMOVED_DELETE,
+                    bucket, key, version_id=dinfo.version_id or vid,
                 )
                 if not quiet:
                     deleted.append(key)
@@ -978,6 +1082,12 @@ class _Handler(BaseHTTPRequestHandler):
             bucket, key, hreader, len(file_data), meta
         )
         status = form.get("success_action_status", "204")
+        from ..event.event import EventName
+
+        self._notify(
+            EventName.OBJECT_CREATED_POST, bucket, key,
+            info.etag, info.size, info.version_id,
+        )
         etag_hdr = {"ETag": f'"{info.etag}"'}
         if status == "201":
             location = f"{self.s3.endpoint}/{bucket}/{key}"
@@ -1087,18 +1197,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", ct)
         self.send_header("Content-Length", str(length))
         self.end_headers()
-        if length == 0:
-            return
-        try:
-            ol.get_object(
-                bucket, key, self.wfile, lo, length, version_id
-            )
-            self._resp_bytes += length
-        except Exception:  # noqa: BLE001
-            # headers already sent; the only honest signal is a broken
-            # connection (the reference behaves the same mid-stream)
-            self.close_connection = True
-            raise ConnectionError("mid-stream decode failure") from None
+        if length:
+            try:
+                ol.get_object(
+                    bucket, key, self.wfile, lo, length, version_id
+                )
+                self._resp_bytes += length
+            except Exception:  # noqa: BLE001
+                # headers already sent; the only honest signal is a
+                # broken connection (the reference behaves the same)
+                self.close_connection = True
+                raise ConnectionError(
+                    "mid-stream decode failure"
+                ) from None
+        from ..event.event import EventName
+
+        self._notify(
+            EventName.OBJECT_ACCESSED_GET, bucket, key,
+            size=length, version_id=version_id,
+        )
 
     def _head_object(self, bucket, key, query):
         version_id = query.get("versionId", [""])[0]
@@ -1118,6 +1235,12 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self.send_header("Content-Length", str(info.size))
         self.end_headers()
+        from ..event.event import EventName
+
+        self._notify(
+            EventName.OBJECT_ACCESSED_HEAD, bucket, key,
+            info.etag, info.size, info.version_id,
+        )
 
     def _collect_user_metadata(self) -> dict:
         meta = {}
@@ -1148,6 +1271,12 @@ class _Handler(BaseHTTPRequestHandler):
         hdrs = {"ETag": f'"{info.etag}"'}
         if info.version_id:
             hdrs["x-amz-version-id"] = info.version_id
+        from ..event.event import EventName
+
+        self._notify(
+            EventName.OBJECT_CREATED_PUT, bucket, key,
+            info.etag, info.size, info.version_id,
+        )
         self._respond(200, b"", hdrs)
 
     def _parse_copy_source(self) -> "tuple[str, str]":
@@ -1186,6 +1315,12 @@ class _Handler(BaseHTTPRequestHandler):
             if info.version_id
             else None
         )
+        from ..event.event import EventName
+
+        self._notify(
+            EventName.OBJECT_CREATED_COPY, bucket, key,
+            info.etag, info.size, info.version_id,
+        )
         self._respond(
             200, xmlr.copy_object_xml(info.etag, info.mod_time_ns), hdrs
         )
@@ -1203,6 +1338,14 @@ class _Handler(BaseHTTPRequestHandler):
                 hdrs["x-amz-delete-marker"] = "true"
             if info.version_id:
                 hdrs["x-amz-version-id"] = info.version_id
+            from ..event.event import EventName
+
+            self._notify(
+                EventName.OBJECT_REMOVED_DELETE_MARKER
+                if info.delete_marker
+                else EventName.OBJECT_REMOVED_DELETE,
+                bucket, key, version_id=info.version_id,
+            )
         except Exception as e:  # noqa: BLE001
             err = s3errors.from_exception(e)
             # deleting what is already gone is success (idempotent, and
@@ -1258,6 +1401,12 @@ class _Handler(BaseHTTPRequestHandler):
         versioned, _ = self._versioning(bucket)
         info = self.s3.object_layer.complete_multipart_upload(
             bucket, key, uid, parts, versioned=versioned
+        )
+        from ..event.event import EventName
+
+        self._notify(
+            EventName.OBJECT_CREATED_COMPLETE_MULTIPART, bucket, key,
+            info.etag, info.size, info.version_id,
         )
         hdrs = (
             {"x-amz-version-id": info.version_id}
