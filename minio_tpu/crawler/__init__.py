@@ -1,0 +1,4 @@
+"""Data crawler: usage accounting + lifecycle enforcement
+(cmd/data-crawler.go, cmd/data-usage.go)."""
+
+from .crawler import DataCrawler, DataUsage  # noqa: F401

@@ -1,0 +1,343 @@
+"""DataCrawler: the background namespace sweep
+(cmd/data-crawler.go:62 runDataCrawler + cmd/data-usage.go).
+
+One daemon thread cycles over every bucket:
+
+- **usage accounting**: objects / versions / delete markers / logical
+  bytes per bucket, persisted as one JSON document under the reserved
+  meta volume (the dataUsageObjName cache the admin API and metrics
+  serve) so a restart starts warm;
+- **lifecycle enforcement**: each version is run through the bucket's
+  parsed Lifecycle (ilm.ComputeAction) and expired objects/versions are
+  deleted through the object layer - versioned buckets get a delete
+  marker for current-version expiry exactly like applyLifecycle
+  (data-crawler.go:877-907);
+- **multipart hygiene**: incomplete uploads older than the rule's
+  DaysAfterInitiation are aborted (the reference does this in the
+  multipart cleanup sweep).
+
+The crawler paces itself (``sleep_every``/``sleep_s``) instead of
+scanning flat out - the dataCrawlSleepPerFolder throttle - so a big
+namespace does not monopolize the disks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import threading
+import time
+
+from ..ilm import Action, Lifecycle, LifecycleError
+from ..objectlayer.api import META_BUCKET
+
+USAGE_PATH = "data-usage/usage.json"
+
+
+@dataclasses.dataclass
+class BucketUsage:
+    objects: int = 0  # latest, non-delete-marker versions
+    versions: int = 0  # every journal entry incl. markers
+    delete_markers: int = 0
+    size: int = 0  # logical (client-visible) bytes, latest versions
+    versions_size: int = 0  # logical bytes across ALL versions
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class DataUsage:
+    """The cluster usage snapshot (madmin DataUsageInfo shape)."""
+
+    last_update_ns: int = 0
+    buckets: "dict[str, BucketUsage]" = dataclasses.field(
+        default_factory=dict
+    )
+
+    @property
+    def objects_total(self) -> int:
+        return sum(b.objects for b in self.buckets.values())
+
+    @property
+    def size_total(self) -> int:
+        return sum(b.size for b in self.buckets.values())
+
+    def to_dict(self) -> dict:
+        return {
+            "last_update_ns": self.last_update_ns,
+            "objects_total": self.objects_total,
+            "size_total": self.size_total,
+            "buckets_count": len(self.buckets),
+            "buckets": {
+                name: u.to_dict() for name, u in self.buckets.items()
+            },
+        }
+
+
+class DataCrawler:
+    """Background sweep thread; ``crawl_once`` is also callable
+    directly (tests, admin-triggered scans)."""
+
+    def __init__(
+        self,
+        object_layer,
+        bucket_meta,
+        interval_s: float = 60.0,
+        events=None,
+        ensure_event_rules=None,
+        sleep_every: int = 256,
+        sleep_s: float = 0.05,
+    ):
+        self._ol = object_layer
+        self._meta = bucket_meta
+        self._interval = interval_s
+        self._events = events
+        # server callback hydrating a bucket's notification rules
+        # before we fire (http.py ensure_event_rules); without it a
+        # freshly restarted server would drop every expiry event
+        self._ensure_event_rules = ensure_event_rules
+        self._sleep_every = sleep_every
+        self._sleep_s = sleep_s
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self._mu = threading.Lock()
+        self._crawl_mu = threading.Lock()  # one sweep at a time
+        self._usage = self._load_usage()
+
+    # -- usage persistence (data-usage.go storeDataUsageInBackend) --------
+
+    def _load_usage(self) -> DataUsage:
+        buf = io.BytesIO()
+        try:
+            self._ol.get_object(META_BUCKET, USAGE_PATH, buf)
+            doc = json.loads(buf.getvalue())
+            return DataUsage(
+                last_update_ns=doc.get("last_update_ns", 0),
+                buckets={
+                    name: BucketUsage(**u)
+                    for name, u in doc.get("buckets", {}).items()
+                },
+            )
+        except Exception:  # noqa: BLE001 - cold start
+            return DataUsage()
+
+    def _store_usage(self, usage: DataUsage) -> None:
+        raw = json.dumps(usage.to_dict()).encode()
+        try:
+            self._ol.put_object(
+                META_BUCKET, USAGE_PATH, io.BytesIO(raw), len(raw)
+            )
+        except Exception:  # noqa: BLE001 - cache only, next cycle retries
+            pass
+
+    def usage(self) -> DataUsage:
+        with self._mu:
+            return self._usage
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _bucket_lifecycle(self, bucket: str) -> "Lifecycle | None":
+        try:
+            raw = self._meta.get(bucket).lifecycle_xml
+        except Exception:  # noqa: BLE001
+            return None
+        if not raw:
+            return None
+        try:
+            return Lifecycle.from_xml(raw.encode())
+        except LifecycleError:
+            return None
+
+    def _apply(self, bucket: str, oi, lc: "Lifecycle | None",
+               versioned: bool, suspended: bool) -> bool:
+        """Returns True when the version was expired (skip in usage)."""
+        if lc is None:
+            return False
+        from ..ilm.lifecycle import ObjectOpts
+
+        action = lc.compute_action(
+            ObjectOpts(
+                name=oi.name,
+                mod_time_ns=oi.mod_time_ns,
+                is_latest=oi.is_latest,
+                delete_marker=oi.delete_marker,
+                num_versions=getattr(oi, "num_versions", 1),
+                successor_mod_time_ns=getattr(
+                    oi, "successor_mod_time_ns", 0
+                ),
+            )
+        )
+        dinfo = None
+        try:
+            if action == Action.DELETE:
+                # current-version expiry: a versioning-enabled OR
+                # -suspended bucket mints a marker / replaces the null
+                # version - passing versioned=False there would
+                # recursively destroy every noncurrent version
+                dinfo = self._ol.delete_object(
+                    bucket, oi.name, "",
+                    versioned=versioned, version_suspended=suspended,
+                )
+            elif action == Action.DELETE_VERSION:
+                vid = oi.version_id or "null"
+                self._ol.delete_object(bucket, oi.name, vid)
+            else:
+                return False
+        except Exception:  # noqa: BLE001 - racing deletes are fine
+            return False
+        if self._events is not None:
+            from ..event.event import Event, EventName
+
+            if self._ensure_event_rules is not None:
+                try:
+                    self._ensure_event_rules(bucket)
+                except Exception:  # noqa: BLE001
+                    pass
+            made_marker = dinfo is not None and dinfo.delete_marker
+            self._events.send(
+                Event(
+                    name=EventName.OBJECT_REMOVED_DELETE_MARKER
+                    if made_marker
+                    else EventName.OBJECT_REMOVED_DELETE,
+                    bucket=bucket,
+                    object_key=oi.name,
+                    version_id=(
+                        dinfo.version_id if made_marker else oi.version_id
+                    ),
+                )
+            )
+        return True
+
+    def _abort_stale_uploads(
+        self, bucket: str, lc: "Lifecycle | None"
+    ) -> int:
+        if lc is None:
+            return 0
+        aborted = 0
+        try:
+            uploads = self._ol.list_multipart_uploads(bucket)
+        except Exception:  # noqa: BLE001
+            return 0
+        for up in uploads:
+            cutoff = lc.abort_multipart_before_ns(up.object)
+            if cutoff is None or up.initiated_ns >= cutoff:
+                continue
+            try:
+                self._ol.abort_multipart_upload(
+                    bucket, up.object, up.upload_id
+                )
+                aborted += 1
+            except Exception:  # noqa: BLE001
+                continue
+        return aborted
+
+    # -- the sweep --------------------------------------------------------
+
+    def crawl_once(self) -> DataUsage:
+        # one sweep at a time: an admin-triggered crawl and the
+        # background cycle must not interleave deletes or publish
+        # out-of-order usage snapshots
+        with self._crawl_mu:
+            return self._crawl_locked()
+
+    def _crawl_locked(self) -> DataUsage:
+        usage = DataUsage(last_update_ns=time.time_ns())
+        try:
+            buckets = self._ol.list_buckets()
+        except Exception:  # noqa: BLE001
+            return self.usage()
+        for b in buckets:
+            bucket = b.name
+            if bucket.startswith("."):  # reserved meta volumes
+                continue
+            usage.buckets[bucket] = self._crawl_bucket(bucket)
+        with self._mu:
+            self._usage = usage
+        self._store_usage(usage)
+        return usage
+
+    def _crawl_bucket(self, bucket: str) -> BucketUsage:
+        lc = self._bucket_lifecycle(bucket)
+        versioned = suspended = False
+        try:
+            bm = self._meta.get(bucket)
+            versioned = bm.versioning_enabled
+            suspended = bm.versioning_suspended
+        except Exception:  # noqa: BLE001
+            pass
+        bu = BucketUsage()
+        seen = 0
+
+        def process_key(rows: list) -> None:
+            """All versions of ONE key (journal order: newest first);
+            grouping here gives lifecycle real num_versions and
+            successor mod times."""
+            nonlocal seen
+            for idx, oi in enumerate(rows):
+                seen += 1
+                if self._sleep_every and seen % self._sleep_every == 0:
+                    time.sleep(self._sleep_s)  # crawl throttle
+                oi.num_versions = len(rows)
+                oi.successor_mod_time_ns = (
+                    rows[idx - 1].mod_time_ns if idx else 0
+                )
+                if self._apply(bucket, oi, lc, versioned, suspended):
+                    continue
+                bu.versions += 1
+                if oi.delete_marker:
+                    bu.delete_markers += 1
+                else:
+                    bu.versions_size += oi.size
+                if oi.is_latest and not oi.delete_marker:
+                    bu.objects += 1
+                    bu.size += oi.size
+
+        key_marker = vid_marker = ""
+        group: list = []
+        while True:
+            try:
+                page = self._ol.list_object_versions(
+                    bucket, "", key_marker, vid_marker, "", 1000
+                )
+            except Exception:  # noqa: BLE001
+                break
+            for oi in page.versions:
+                if group and oi.name != group[0].name:
+                    process_key(group)
+                    group = []
+                group.append(oi)
+            if not page.is_truncated:
+                break
+            # a key's versions may span pages: keep buffering the
+            # current group across the boundary
+            key_marker = page.next_key_marker
+            vid_marker = page.next_version_id_marker
+        if group:
+            process_key(group)
+        self._abort_stale_uploads(bucket, lc)
+        return bu
+
+    # -- lifecycle of the thread itself -----------------------------------
+
+    def start(self) -> "DataCrawler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="data-crawler"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        # initial delay so boot IO settles (crawler waits a cycle)
+        while not self._stop.wait(self._interval):
+            try:
+                self.crawl_once()
+            except Exception:  # noqa: BLE001 - never kill the thread
+                pass

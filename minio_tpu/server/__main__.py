@@ -324,6 +324,19 @@ def main(argv=None) -> int:
     _heal_routine, _disk_monitor = start_background_heal(ol)
     srv.heal_routine = _heal_routine
     srv.heal_queue = _heal_routine.queue
+    # data crawler: usage accounting + lifecycle enforcement
+    # (runDataCrawler, server-main.go:524 startBackgroundOps)
+    from ..crawler import DataCrawler
+
+    srv.crawler = DataCrawler(
+        ol,
+        srv.bucket_meta,
+        interval_s=float(
+            os.environ.get("MINIO_TPU_CRAWL_INTERVAL_S") or 60.0
+        ),
+        events=srv.events,
+        ensure_event_rules=srv.ensure_event_rules,
+    ).start()
     si = ol.storage_info()
     print(
         f"minio-tpu serving {len(ol.zones)} zone(s) "

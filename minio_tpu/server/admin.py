@@ -44,6 +44,18 @@ class AdminAPI:
             return 200, self._heal(ol, q)
         if route == ("GET", "top-locks"):
             return 200, self._top_locks()
+        if route == ("GET", "datausage"):
+            crawler = getattr(self.s3, "crawler", None)
+            if crawler is None:
+                from ..crawler import DataUsage
+
+                return 200, _json(DataUsage().to_dict())
+            return 200, _json(crawler.usage().to_dict())
+        if route == ("POST", "crawl"):
+            crawler = getattr(self.s3, "crawler", None)
+            if crawler is None:
+                raise S3Error("ServerNotInitialized")
+            return 200, _json(crawler.crawl_once().to_dict())
         # IAM management
         iam = self.s3.iam
         if route == ("GET", "list-users"):

@@ -770,6 +770,8 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if "notification" in query:
                 return self._get_bucket_notification(bucket)
+            if "lifecycle" in query:
+                return self._get_bucket_lifecycle(bucket)
             return self._list_objects(bucket, query)
         if m == "HEAD":
             ol.get_bucket_info(bucket)
@@ -785,12 +787,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._put_bucket_notification(
                     bucket, self._read_body()
                 )
+            if "lifecycle" in query:
+                return self._put_bucket_lifecycle(
+                    bucket, self._read_body()
+                )
             ol.make_bucket(bucket)
             return self._respond(200, headers={"Location": f"/{bucket}"})
         if m == "DELETE":
             if "policy" in query:
                 ol.get_bucket_info(bucket)
                 self.s3.bucket_meta.update(bucket, policy_json="")
+                return self._respond(204)
+            if "lifecycle" in query:
+                ol.get_bucket_info(bucket)
+                self.s3.bucket_meta.update(bucket, lifecycle_xml="")
                 return self._respond(204)
             ol.delete_bucket(bucket)
             self.s3.bucket_meta.delete(bucket)
@@ -958,6 +968,28 @@ class _Handler(BaseHTTPRequestHandler):
             bucket, notification_xml=cfg.to_xml().decode()
         )
         self.s3.mark_event_rules_loaded(bucket)
+        self._respond(200)
+
+    # -- bucket lifecycle (bucket-lifecycle-handlers.go) ------------------
+
+    def _get_bucket_lifecycle(self, bucket: str):
+        self.s3.object_layer.get_bucket_info(bucket)
+        raw = self.s3.bucket_meta.get(bucket).lifecycle_xml
+        if not raw:
+            raise S3Error("NoSuchLifecycleConfiguration")
+        self._respond(200, raw.encode())
+
+    def _put_bucket_lifecycle(self, bucket: str, body: bytes):
+        from ..ilm import Lifecycle, LifecycleError
+
+        self.s3.object_layer.get_bucket_info(bucket)
+        try:
+            lc = Lifecycle.from_xml(body)
+        except LifecycleError as e:
+            raise S3Error("MalformedXML", str(e)) from None
+        self.s3.bucket_meta.update(
+            bucket, lifecycle_xml=lc.to_xml().decode()
+        )
         self._respond(200)
 
     def _notify(

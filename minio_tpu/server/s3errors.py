@@ -49,6 +49,7 @@ _E = {
     "MissingContentLength": ("You must provide the Content-Length HTTP header.", H.LENGTH_REQUIRED),
     "NoSuchBucket": ("The specified bucket does not exist", H.NOT_FOUND),
     "NoSuchBucketPolicy": ("The bucket policy does not exist", H.NOT_FOUND),
+    "NoSuchLifecycleConfiguration": ("The lifecycle configuration does not exist", H.NOT_FOUND),
     "AllAccessDisabled": ("All access to this bucket has been disabled.", H.FORBIDDEN),
     "MalformedPolicy": ("Policy has invalid resource.", H.BAD_REQUEST),
     "NoSuchKey": ("The specified key does not exist.", H.NOT_FOUND),
