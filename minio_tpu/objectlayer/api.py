@@ -188,17 +188,20 @@ def check_object_name(name: str) -> None:
 
 def prepare_copy_meta(src_info, metadata: "dict | None") -> dict:
     """Destination metadata for CopyObject: source user metadata with
-    directive overrides applied, minus the etag and the internal
-    compression markers - the copy pipe carries decompressed plaintext
-    and the destination put re-decides compression, so stale markers
-    would make GET return raw deflate bytes."""
-    from ..codec.compress import strip_internal_meta
-
-    meta = dict(src_info.user_defined)
+    directive overrides applied, minus the etag and EVERY internal
+    transform marker (compression, SSE, ...) - the copy pipe carries
+    decoded plaintext and the destination put re-applies its own
+    transforms, so a stale marker would make GET misinterpret the
+    stored bytes."""
+    meta = {
+        k: v
+        for k, v in src_info.user_defined.items()
+        if not k.startswith("x-internal-")
+    }
     if metadata:
         meta.update(metadata)
     meta.pop("etag", None)
-    return strip_internal_meta(meta)
+    return meta
 
 
 class ObjectLayer:

@@ -19,7 +19,7 @@ from __future__ import annotations
 import hashlib
 import uuid
 
-from ..codec import compress as compmod
+from ..codec import compress as compmod, sse as ssemod
 from ..codec.erasure import Erasure, QuorumError
 from ..storage import errors as serrors
 from ..storage.meta import (
@@ -75,7 +75,7 @@ class MultipartMixin:
     # -- API -------------------------------------------------------------
 
     def new_multipart_upload(
-        self, bucket, object_name, metadata=None
+        self, bucket, object_name, metadata=None, sse=None
     ) -> str:
         check_object_name(object_name)
         self._require_bucket(bucket)
@@ -90,6 +90,16 @@ class MultipartMixin:
             object_name, meta.get("content-type", ""), -1
         ):
             meta[compmod.META_COMPRESSION] = compmod.ALGORITHM
+        # one object key per upload, sealed at initiation; every part
+        # encrypts under it with a part-derived nonce prefix
+        if sse is not None:
+            oek = ssemod.new_object_key()
+            nb = ssemod.new_nonce_base()
+            meta.update(
+                self._seal_sse_meta(
+                    sse, oek, nb, f"{bucket}/{object_name}"
+                )
+            )
         distribution = hash_order(
             f"{bucket}/{object_name}", len(self.disks)
         )
@@ -124,7 +134,7 @@ class MultipartMixin:
 
     def put_object_part(
         self, bucket, object_name, upload_id, part_number, reader,
-        size=-1,
+        size=-1, sse=None,
     ) -> PartInfo:
         if not (1 <= part_number <= 10000):
             raise InvalidPart(f"part number {part_number}")
@@ -138,6 +148,15 @@ class MultipartMixin:
         # the plaintext MD5 the client computed
         compress = bool(mfi.metadata.get(compmod.META_COMPRESSION))
         src = compmod.CompressReader(hreader) if compress else hreader
+        if mfi.metadata.get(ssemod.META_SSE):
+            bkt = mfi.metadata.get("x-internal-bucket", bucket)
+            obj = mfi.metadata.get("x-internal-object", object_name)
+            oek, nb = self._unseal_oek(
+                mfi.metadata, sse, f"{bkt}/{obj}"
+            )
+            src = ssemod.EncryptReader(
+                src, oek, ssemod.part_nonce_base(nb, part_number)
+            )
         disks = shuffle_disks(
             self._online_disks(), mfi.erasure.distribution
         )
@@ -361,6 +380,26 @@ class MultipartMixin:
         meta["etag"] = final_etag
         if mfi.metadata.get(compmod.META_COMPRESSION):
             meta[compmod.META_COMPRESSION] = compmod.ALGORITHM
+        if mfi.metadata.get(ssemod.META_SSE):
+            # carry the sealed key forward, plus the ORIGINAL part
+            # numbers in completion order: chunk nonces derive from the
+            # number each part was uploaded under, which the
+            # renumbering below would otherwise lose
+            for mk in (
+                ssemod.META_SSE,
+                ssemod.META_SSE_SEALED_KEY,
+                ssemod.META_SSE_NONCE,
+                ssemod.META_SSE_KEY_MD5,
+                ssemod.META_SSE_KMS_ID,
+            ):
+                if mk in mfi.metadata:
+                    meta[mk] = mfi.metadata[mk]
+            meta[ssemod.META_SSE_PARTS] = ",".join(
+                str(cp.part_number) for cp, _s, _a in infos
+            )
+        if mfi.metadata.get(compmod.META_COMPRESSION) or mfi.metadata.get(
+            ssemod.META_SSE
+        ):
             meta[compmod.META_ACTUAL_SIZE] = str(total_actual)
 
         with self.nslock.write(bucket, object_name):

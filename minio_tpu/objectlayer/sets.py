@@ -96,16 +96,17 @@ class ErasureSets(ObjectLayer):
     # -- objects (route by key) -------------------------------------------
 
     def put_object(self, bucket, object_name, reader, size=-1, metadata=None,
-                   versioned=False, compress=None):
+                   versioned=False, compress=None, sse=None):
         return self.set_for(object_name).put_object(
             bucket, object_name, reader, size, metadata, versioned,
-            compress,
+            compress, sse,
         )
 
     def get_object(self, bucket, object_name, writer, offset=0, length=-1,
-                   version_id=""):
+                   version_id="", sse=None):
         return self.set_for(object_name).get_object(
-            bucket, object_name, writer, offset, length, version_id
+            bucket, object_name, writer, offset, length, version_id,
+            sse,
         )
 
     def get_object_info(self, bucket, object_name, version_id=""):
@@ -120,23 +121,26 @@ class ErasureSets(ObjectLayer):
         )
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
-                    metadata=None, versioned=False):
+                    metadata=None, versioned=False, sse_src=None,
+                    sse=None):
         src_set = self.set_for(src_object)
         dst_set = self.set_for(dst_object)
         if src_set is dst_set:
             return src_set.copy_object(
                 src_bucket, src_object, dst_bucket, dst_object, metadata,
-                versioned,
+                versioned, sse_src, sse,
             )
         from ..utils.pipe import streaming_copy
 
         info = src_set.get_object_info(src_bucket, src_object)
         meta = api.prepare_copy_meta(info, metadata)
         return streaming_copy(
-            lambda sink: src_set.get_object(src_bucket, src_object, sink),
+            lambda sink: src_set.get_object(
+                src_bucket, src_object, sink, sse=sse_src
+            ),
             lambda source: dst_set.put_object(
                 dst_bucket, dst_object, source, info.size, meta,
-                versioned=versioned,
+                versioned=versioned, sse=sse,
             ),
         )
 
@@ -190,15 +194,17 @@ class ErasureSets(ObjectLayer):
 
     # -- multipart (route by key) -----------------------------------------
 
-    def new_multipart_upload(self, bucket, object_name, metadata=None):
+    def new_multipart_upload(self, bucket, object_name, metadata=None,
+                             sse=None):
         return self.set_for(object_name).new_multipart_upload(
-            bucket, object_name, metadata
+            bucket, object_name, metadata, sse
         )
 
     def put_object_part(self, bucket, object_name, upload_id, part_number,
-                        reader, size=-1):
+                        reader, size=-1, sse=None):
         return self.set_for(object_name).put_object_part(
-            bucket, object_name, upload_id, part_number, reader, size
+            bucket, object_name, upload_id, part_number, reader, size,
+            sse,
         )
 
     def list_object_parts(self, bucket, object_name, upload_id,

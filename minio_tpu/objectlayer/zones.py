@@ -108,20 +108,21 @@ class ErasureZones(ObjectLayer):
     # -- objects ----------------------------------------------------------
 
     def put_object(self, bucket, object_name, reader, size=-1, metadata=None,
-                   versioned=False, compress=None):
+                   versioned=False, compress=None, sse=None):
         self.zones[0].get_bucket_info(bucket)  # bucket must exist
         zi = self._put_zone_index(bucket, object_name)
         return self.zones[zi].put_object(
             bucket, object_name, reader, size, metadata, versioned,
-            compress,
+            compress, sse,
         )
 
     def get_object(self, bucket, object_name, writer, offset=0, length=-1,
-                   version_id=""):
+                   version_id="", sse=None):
         self.zones[0].get_bucket_info(bucket)
         z = self._find_zone(bucket, object_name, version_id)
         return z.get_object(
-            bucket, object_name, writer, offset, length, version_id
+            bucket, object_name, writer, offset, length, version_id,
+            sse,
         )
 
     def get_object_info(self, bucket, object_name, version_id=""):
@@ -164,7 +165,8 @@ class ErasureZones(ObjectLayer):
         return z.delete_object(bucket, object_name, version_id)
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
-                    metadata=None, versioned=False):
+                    metadata=None, versioned=False, sse_src=None,
+                    sse=None):
         from ..utils.pipe import streaming_copy
 
         src_zone = self._find_zone(src_bucket, src_object)
@@ -173,15 +175,17 @@ class ErasureZones(ObjectLayer):
             # path avoids the namespace-lock deadlock
             return src_zone.copy_object(
                 src_bucket, src_object, dst_bucket, dst_object,
-                metadata, versioned,
+                metadata, versioned, sse_src, sse,
             )
         info = src_zone.get_object_info(src_bucket, src_object)
         meta = api.prepare_copy_meta(info, metadata)
         return streaming_copy(
-            lambda sink: src_zone.get_object(src_bucket, src_object, sink),
+            lambda sink: src_zone.get_object(
+                src_bucket, src_object, sink, sse=sse_src
+            ),
             lambda source: self.put_object(
                 dst_bucket, dst_object, source, info.size, meta,
-                versioned=versioned,
+                versioned=versioned, sse=sse,
             ),
         )
 
@@ -231,11 +235,12 @@ class ErasureZones(ObjectLayer):
 
     # -- multipart (pin the upload's zone at initiate time) ---------------
 
-    def new_multipart_upload(self, bucket, object_name, metadata=None):
+    def new_multipart_upload(self, bucket, object_name, metadata=None,
+                             sse=None):
         self.zones[0].get_bucket_info(bucket)
         zi = self._put_zone_index(bucket, object_name)
         uid = self.zones[zi].new_multipart_upload(
-            bucket, object_name, metadata
+            bucket, object_name, metadata, sse
         )
         return f"{zi}.{uid}"
 
@@ -247,10 +252,10 @@ class ErasureZones(ObjectLayer):
             raise api.InvalidUploadID(upload_id) from None
 
     def put_object_part(self, bucket, object_name, upload_id, part_number,
-                        reader, size=-1):
+                        reader, size=-1, sse=None):
         z, uid = self._upload_zone(upload_id)
         return z.put_object_part(
-            bucket, object_name, uid, part_number, reader, size
+            bucket, object_name, uid, part_number, reader, size, sse
         )
 
     def list_object_parts(self, bucket, object_name, upload_id,
