@@ -244,6 +244,14 @@ class ObjectLayer:
     ) -> ObjectInfo:
         raise NotImplementedError
 
+    def update_object_meta(
+        self, bucket: str, object_name: str, updates: dict,
+        version_id: str = "",
+    ) -> ObjectInfo:
+        """Merge metadata updates into an existing version (tags,
+        retention, legal hold).  None values remove keys."""
+        raise NotImplementedError
+
     def copy_object(
         self, src_bucket: str, src_object: str, dst_bucket: str,
         dst_object: str, metadata: "dict | None" = None,

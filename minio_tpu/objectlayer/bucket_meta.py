@@ -39,6 +39,7 @@ class BucketMetadata:
     notification_xml: str = ""
     object_lock_xml: str = ""
     sse_config_xml: str = ""
+    replication_xml: str = ""
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -413,6 +413,63 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             raise ObjectNotFound(f"{bucket}/{object_name}")
         return self._to_object_info(bucket, object_name, fi)
 
+    def update_object_meta(
+        self, bucket, object_name, updates: dict, version_id=""
+    ) -> ObjectInfo:
+        """Merge metadata updates into an existing version on every disk
+        holding it - the PutObjectTags / PutObjectRetention seam
+        (erasure-object.go PutObjectTags -> disk.UpdateMetadata).
+
+        A key mapped to None is removed; other keys are set.  The quorum
+        version is located first, then each agreeing disk rewrites its
+        own FileInfo (preserving its per-disk erasure index)."""
+        check_object_name(object_name)
+        self._require_bucket(bucket)
+        with self.nslock.write(bucket, object_name):
+            disks = self._online_disks()
+            fis, _errs = read_all_fileinfo(
+                disks, bucket, object_name, version_id
+            )
+            not_found = sum(
+                isinstance(e, (serrors.FileNotFound, serrors.VersionNotFound))
+                for e in _errs
+            )
+            if not_found > len(self.disks) - self.read_quorum:
+                if version_id and any(
+                    isinstance(e, serrors.VersionNotFound) for e in _errs
+                ):
+                    raise api.VersionNotFound(f"{bucket}/{object_name}")
+                raise ObjectNotFound(f"{bucket}/{object_name}")
+            fi = find_fileinfo_in_quorum(fis, self.read_quorum)
+            if fi.deleted:
+                raise ObjectNotFound(f"{bucket}/{object_name}")
+            merged = dict(fi.metadata)
+            for k, v in updates.items():
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+            qkey = (fi.mod_time_ns, fi.data_dir, fi.deleted)
+            errs = []
+            for i, d in enumerate(disks):
+                dfi = fis[i]
+                if (
+                    d is None
+                    or dfi is None
+                    or (dfi.mod_time_ns, dfi.data_dir, dfi.deleted) != qkey
+                ):
+                    errs.append(serrors.DiskNotFound("offline"))
+                    continue
+                dfi.metadata = dict(merged)
+                try:
+                    d.update_metadata(bucket, object_name, dfi)
+                    errs.append(None)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+            reduce_errs(errs, self.write_quorum, WriteQuorumError)
+            fi.metadata = merged
+            return self._to_object_info(bucket, object_name, fi)
+
     @staticmethod
     def _seal_sse_meta(sse, oek: bytes, nonce_base: bytes, aad: str,
                        part_numbers: "list[int] | None" = None) -> dict:

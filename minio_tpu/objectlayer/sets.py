@@ -114,6 +114,12 @@ class ErasureSets(ObjectLayer):
             bucket, object_name, version_id
         )
 
+    def update_object_meta(self, bucket, object_name, updates,
+                           version_id=""):
+        return self.set_for(object_name).update_object_meta(
+            bucket, object_name, updates, version_id
+        )
+
     def delete_object(self, bucket, object_name, version_id="",
                       versioned=False, version_suspended=False):
         return self.set_for(object_name).delete_object(

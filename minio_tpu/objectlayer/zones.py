@@ -130,6 +130,14 @@ class ErasureZones(ObjectLayer):
         z = self._find_zone(bucket, object_name, version_id)
         return z.get_object_info(bucket, object_name, version_id)
 
+    def update_object_meta(self, bucket, object_name, updates,
+                           version_id=""):
+        self.zones[0].get_bucket_info(bucket)
+        z = self._find_zone(bucket, object_name, version_id)
+        return z.update_object_meta(
+            bucket, object_name, updates, version_id
+        )
+
     def _zone_with_versions(self, bucket, object_name):
         """First zone holding ANY journal entry for the key (incl.
         delete markers, which get_object_info cannot see)."""

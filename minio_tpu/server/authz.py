@@ -23,6 +23,9 @@ _BUCKET_GET_SUBRESOURCES = {
     "versions": "s3:ListBucketVersions",
     "object-lock": "s3:GetBucketObjectLockConfiguration",
     "encryption": "s3:GetEncryptionConfiguration",
+    "replication": "s3:GetReplicationConfiguration",
+    # ACL stubs are gated on the policy action (acl-handlers.go:142)
+    "acl": "s3:GetBucketPolicy",
 }
 
 _BUCKET_PUT_SUBRESOURCES = {
@@ -33,6 +36,8 @@ _BUCKET_PUT_SUBRESOURCES = {
     "notification": "s3:PutBucketNotification",
     "object-lock": "s3:PutBucketObjectLockConfiguration",
     "encryption": "s3:PutEncryptionConfiguration",
+    "replication": "s3:PutReplicationConfiguration",
+    "acl": "s3:PutBucketPolicy",
 }
 
 _BUCKET_DELETE_SUBRESOURCES = {
@@ -40,6 +45,7 @@ _BUCKET_DELETE_SUBRESOURCES = {
     "tagging": "s3:PutBucketTagging",
     "lifecycle": "s3:PutLifecycleConfiguration",
     "encryption": "s3:PutEncryptionConfiguration",
+    "replication": "s3:PutReplicationConfiguration",
 }
 
 _OBJECT_GET_SUBRESOURCES = {

@@ -70,6 +70,71 @@ _E = {
     "AuthorizationHeaderMalformed": ("The authorization header is malformed.", H.BAD_REQUEST),
     "AuthorizationQueryParametersError": ("Query-string authentication parameters are malformed.", H.BAD_REQUEST),
     "NotModified": ("Not Modified", H.NOT_MODIFIED),
+    # -- tagging (api-errors.go ErrBucketTaggingNotFound / ErrInvalidTag)
+    "NoSuchTagSet": ("The TagSet does not exist", H.NOT_FOUND),
+    "InvalidTag": ("The tag provided was not a valid tag. This error can occur if the tag did not pass input validation.", H.BAD_REQUEST),
+    "InvalidTagDirective": ("Unknown tag directive.", H.BAD_REQUEST),
+    # -- object lock / retention / legal hold (api-errors.go:171-181)
+    "InvalidBucketObjectLockConfiguration": ("Bucket is missing ObjectLockConfiguration", H.BAD_REQUEST),
+    "ObjectLockConfigurationNotFoundError": ("Object Lock configuration does not exist for this bucket", H.NOT_FOUND),
+    "InvalidBucketState": ("Object Lock configuration cannot be enabled on existing buckets", H.CONFLICT),
+    "NoSuchObjectLockConfiguration": ("The specified object does not have a ObjectLock configuration", H.BAD_REQUEST),
+    "ObjectLocked": ("Object is WORM protected and cannot be overwritten", H.BAD_REQUEST),
+    "InvalidRetentionDate": ("Date must be provided in ISO 8601 format", H.BAD_REQUEST),
+    "PastObjectLockRetainDate": ("the retain until date must be in the future", H.BAD_REQUEST),
+    "UnknownWORMModeDirective": ("unknown WORM mode directive", H.BAD_REQUEST),
+    "ObjectLockInvalidHeaders": ("x-amz-object-lock-retain-until-date and x-amz-object-lock-mode must both be supplied", H.BAD_REQUEST),
+    # -- bucket config long tail
+    "ServerSideEncryptionConfigurationNotFoundError": ("The server side encryption configuration was not found", H.NOT_FOUND),
+    "NoSuchCORSConfiguration": ("The CORS configuration does not exist", H.NOT_FOUND),
+    "NoSuchWebsiteConfiguration": ("The specified bucket does not have a website configuration", H.NOT_FOUND),
+    "ReplicationConfigurationNotFoundError": ("The replication configuration was not found", H.NOT_FOUND),
+    "ReplicationDestinationNotFoundError": ("The replication destination bucket does not exist", H.NOT_FOUND),
+    "ReplicationTargetNotVersionedError": ("The replication target does not have versioning enabled", H.BAD_REQUEST),
+    "ReplicationSourceNotVersionedError": ("The replication source does not have versioning enabled", H.BAD_REQUEST),
+    "XMinioAdminBucketQuotaExceeded": ("Bucket quota exceeded", H.BAD_REQUEST),
+    "XMinioAdminNoSuchQuotaConfiguration": ("The quota configuration does not exist", H.NOT_FOUND),
+    # -- misc request validation
+    "InvalidStorageClass": ("Invalid storage class.", H.BAD_REQUEST),
+    "InvalidPolicyDocument": ("The content of the form does not meet the conditions specified in the policy document.", H.BAD_REQUEST),
+    "PolicyTooLarge": ("Policy exceeds the maximum allowed document size.", H.BAD_REQUEST),
+    "MissingContentMD5": ("Missing required header for this request: Content-Md5.", H.BAD_REQUEST),
+    "MissingSecurityHeader": ("Your request was missing a required header", H.BAD_REQUEST),
+    "MissingRequestBodyError": ("Request body is empty.", H.LENGTH_REQUIRED),
+    "InvalidObjectState": ("The operation is not valid for the current state of the object.", H.FORBIDDEN),
+    "InvalidRegion": ("Region does not match.", H.BAD_REQUEST),
+    "InvalidPrefixMarker": ("Invalid marker prefix combination", H.BAD_REQUEST),
+    "BadRequest": ("400 BadRequest", H.BAD_REQUEST),
+    "InvalidDuration": ("Duration provided in the request is invalid.", H.BAD_REQUEST),
+    "InvalidTokenId": ("The security token included in the request is invalid", H.FORBIDDEN),
+    "RequestTimeout": ("Your socket connection to the server was not read from or written to within the timeout period.", H.BAD_REQUEST),
+    "UnsupportedNotification": ("MinIO server does not support Tilde, Period characters in prefix/suffix for notifications.", H.BAD_REQUEST),
+    "XMinioInvalidObjectName": ("Object name contains unsupported characters.", H.BAD_REQUEST),
+    "XMinioStorageFull": ("Storage backend has reached its minimum free disk threshold. Please delete a few objects to proceed.", H.INSUFFICIENT_STORAGE),
+    "XMinioObjectTampered": ("The requested object was modified and may be compromised", H.PARTIAL_CONTENT),
+    "XMinioBackendDown": ("Object storage backend is unreachable", H.SERVICE_UNAVAILABLE),
+    # -- STS (cmd/sts-errors.go)
+    "InvalidParameterValue": ("An invalid or out-of-range value was supplied for the input parameter.", H.BAD_REQUEST),
+    "STSMissingParameter": ("A required parameter for the specified action is not supplied.", H.BAD_REQUEST),
+    "STSInvalidClientTokenId": ("The security token included in the request is invalid.", H.FORBIDDEN),
+    "STSAccessDenied": ("Generating temporary credentials not allowed for this request.", H.FORBIDDEN),
+    "STSInternalError": ("We encountered an internal error generating credentials, please try again.", H.INTERNAL_SERVER_ERROR),
+    # -- S3 Select (pkg/s3select errors surfaced through api-errors.go)
+    "EmptyRequestBody": ("Request body cannot be empty.", H.BAD_REQUEST),
+    "UnsupportedFunction": ("Encountered an unsupported SQL function.", H.BAD_REQUEST),
+    "InvalidDataSource": ("Invalid data source type. Only CSV and JSON are supported at this time.", H.BAD_REQUEST),
+    "InvalidExpressionType": ("The ExpressionType is invalid. Only SQL expressions are supported at this time.", H.BAD_REQUEST),
+    "InvalidRequestParameter": ("The value of a parameter in SelectRequest element is invalid. Check the service API documentation and try again.", H.BAD_REQUEST),
+    "InvalidFileHeaderInfo": ("The FileHeaderInfo is invalid. Only NONE, USE, and IGNORE are supported.", H.BAD_REQUEST),
+    "InvalidQuoteFields": ("The QuoteFields is invalid. Only ALWAYS and ASNEEDED are supported.", H.BAD_REQUEST),
+    "InvalidJsonType": ("The JsonType is invalid. Only DOCUMENT and LINES are supported at this time.", H.BAD_REQUEST),
+    "InvalidCompressionFormat": ("The file is not in a supported compression format. Only GZIP and BZIP2 are supported.", H.BAD_REQUEST),
+    "InvalidTextEncoding": ("Invalid encoding type. Only UTF-8 encoding is supported at this time.", H.BAD_REQUEST),
+    "ParseSelectFailure": ("The SQL expression cannot be parsed.", H.BAD_REQUEST),
+    "UnsupportedSqlOperation": ("Encountered an unsupported SQL operation.", H.BAD_REQUEST),
+    "UnsupportedSqlStructure": ("Encountered an unsupported SQL structure. Check the SQL Reference.", H.BAD_REQUEST),
+    "UnsupportedSyntax": ("Encountered invalid syntax.", H.BAD_REQUEST),
+    "MissingRequiredParameter": ("The SelectRequest entity is missing a required parameter. Check the service documentation and try again.", H.BAD_REQUEST),
 }
 
 

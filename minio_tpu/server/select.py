@@ -1,0 +1,10 @@
+"""SelectObjectContent glue (cmd/object-handlers.go:91 ->
+pkg/s3select).  Full engine lands in minio_tpu/s3select/."""
+
+from __future__ import annotations
+
+from .s3errors import S3Error
+
+
+def handle_select(handler, bucket, key, info, body) -> None:
+    raise S3Error("NotImplemented", "SelectObjectContent")

@@ -1,0 +1,484 @@
+"""Tagging, object lock / retention / legal hold, and the strict
+sub-resource routing contract (no silent fall-through).
+
+Reference behaviors: cmd/api-router.go:94-359 (route table),
+cmd/bucket-object-lock.go (WORM enforcement), dummy-handlers.go (static
+configs), bucket-handlers.go:528 (lock-enabled bucket creation).
+"""
+
+import datetime
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+BLOCK = 4096
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("disks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return S3Client(server.endpoint)
+
+
+def _future(days=1):
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        + datetime.timedelta(days=days)
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+TAGGING_XML = (
+    b'<Tagging><TagSet>'
+    b"<Tag><Key>env</Key><Value>prod</Value></Tag>"
+    b"<Tag><Key>team</Key><Value>infra</Value></Tag>"
+    b"</TagSet></Tagging>"
+)
+
+
+# -- the fall-through contract (VERDICT r3 weak #1) -----------------------
+
+
+def test_unknown_bucket_subresource_is_not_listing(client):
+    client.make_bucket("sub1")
+    client.put_object("sub1", "x", b"data")
+    # GET ?inventory must NOT return an object listing
+    r = client.request("GET", "/sub1", query={"inventory": ""})
+    assert r.status == 501
+    assert r.error_code == "NotImplemented"
+    r = client.request("GET", "/sub1", query={"analytics": ""})
+    assert r.status == 501
+
+
+def test_unknown_object_subresource_is_not_object_bytes(client):
+    client.make_bucket("sub2")
+    client.put_object("sub2", "obj", b"payload-bytes")
+    r = client.request("GET", "/sub2/obj", query={"torrent": ""})
+    assert r.status == 501
+    assert r.error_code == "NotImplemented"
+    assert b"payload-bytes" not in r.body
+    # restore on POST also errs, not a multipart dispatch
+    r = client.request("POST", "/sub2/obj", query={"restore": ""})
+    assert r.status == 501
+
+
+def test_put_bucket_subresource_does_not_make_bucket(client):
+    r = client.request(
+        "PUT", "/never-created", query={"requestPayment": ""},
+        body=b"<x/>",
+    )
+    assert r.status == 501
+    assert client.request("HEAD", "/never-created").status == 404
+
+
+def test_dummy_subresources_match_reference(client):
+    client.make_bucket("dummy")
+    r = client.request("GET", "/dummy", query={"cors": ""})
+    assert r.status == 404 and r.error_code == "NoSuchCORSConfiguration"
+    r = client.request("GET", "/dummy", query={"website": ""})
+    assert r.status == 404 and r.error_code == "NoSuchWebsiteConfiguration"
+    r = client.request("GET", "/dummy", query={"accelerate": ""})
+    assert r.status == 200 and b"AccelerateConfiguration" in r.body
+    r = client.request("GET", "/dummy", query={"requestPayment": ""})
+    assert r.status == 200 and b"BucketOwner" in r.body
+    r = client.request("GET", "/dummy", query={"logging": ""})
+    assert r.status == 200 and b"BucketLoggingStatus" in r.body
+    r = client.request("GET", "/dummy", query={"acl": ""})
+    assert r.status == 200 and b"FULL_CONTROL" in r.body
+    r = client.request("GET", "/dummy", query={"replication": ""})
+    assert r.status == 404
+    assert r.error_code == "ReplicationConfigurationNotFoundError"
+
+
+# -- bucket tagging -------------------------------------------------------
+
+
+def test_bucket_tagging_crud(client):
+    client.make_bucket("btags")
+    r = client.request("GET", "/btags", query={"tagging": ""})
+    assert r.status == 404 and r.error_code == "NoSuchTagSet"
+    r = client.request(
+        "PUT", "/btags", query={"tagging": ""}, body=TAGGING_XML
+    )
+    assert r.status == 200
+    r = client.request("GET", "/btags", query={"tagging": ""})
+    assert r.status == 200
+    assert "env" in r.xml_all("Key") and "prod" in r.xml_all("Value")
+    r = client.request("DELETE", "/btags", query={"tagging": ""})
+    assert r.status == 204
+    r = client.request("GET", "/btags", query={"tagging": ""})
+    assert r.status == 404
+
+
+def test_bucket_tagging_invalid(client):
+    client.make_bucket("btags2")
+    r = client.request(
+        "PUT", "/btags2", query={"tagging": ""}, body=b"<junk"
+    )
+    assert r.status == 400
+    # duplicate keys rejected
+    dup = (
+        b"<Tagging><TagSet>"
+        b"<Tag><Key>a</Key><Value>1</Value></Tag>"
+        b"<Tag><Key>a</Key><Value>2</Value></Tag>"
+        b"</TagSet></Tagging>"
+    )
+    r = client.request("PUT", "/btags2", query={"tagging": ""}, body=dup)
+    assert r.status == 400 and r.error_code == "InvalidTag"
+
+
+# -- object tagging -------------------------------------------------------
+
+
+def test_object_tagging_crud(client):
+    client.make_bucket("otags")
+    client.put_object("otags", "obj", b"hello world")
+    r = client.request("GET", "/otags/obj", query={"tagging": ""})
+    assert r.status == 200 and r.xml_all("Tag") == []
+    r = client.request(
+        "PUT", "/otags/obj", query={"tagging": ""}, body=TAGGING_XML
+    )
+    assert r.status == 200
+    r = client.request("GET", "/otags/obj", query={"tagging": ""})
+    assert r.status == 200
+    assert sorted(r.xml_all("Key")) == ["env", "team"]
+    # tags survive but object bytes are untouched
+    assert client.get_object("otags", "obj").body == b"hello world"
+    r = client.request("DELETE", "/otags/obj", query={"tagging": ""})
+    assert r.status == 204
+    r = client.request("GET", "/otags/obj", query={"tagging": ""})
+    assert r.xml_all("Tag") == []
+
+
+def test_object_tagging_header_on_put(client):
+    client.make_bucket("otags2")
+    client.put_object(
+        "otags2", "obj", b"x", headers={"x-amz-tagging": "a=1&b=2"}
+    )
+    r = client.request("GET", "/otags2/obj", query={"tagging": ""})
+    assert sorted(r.xml_all("Key")) == ["a", "b"]
+    # the count surfaces on GET object
+    r = client.get_object("otags2", "obj")
+    assert r.headers.get("x-amz-tagging-count") == "2"
+
+
+def test_object_tagging_missing_object(client):
+    client.make_bucket("otags3")
+    r = client.request("GET", "/otags3/ghost", query={"tagging": ""})
+    assert r.status == 404
+    r = client.request(
+        "PUT", "/otags3/ghost", query={"tagging": ""}, body=TAGGING_XML
+    )
+    assert r.status == 404
+
+
+# -- object lock ----------------------------------------------------------
+
+
+def _make_locked_bucket(client, name):
+    r = client.request(
+        "PUT", f"/{name}",
+        headers={"x-amz-bucket-object-lock-enabled": "true"},
+    )
+    assert r.status == 200
+    return r
+
+
+def test_lock_bucket_creation(client):
+    _make_locked_bucket(client, "locked1")
+    r = client.request("GET", "/locked1", query={"object-lock": ""})
+    assert r.status == 200
+    assert b"<ObjectLockEnabled>Enabled</ObjectLockEnabled>" in r.body
+    # born versioned
+    r = client.request("GET", "/locked1", query={"versioning": ""})
+    assert b"Enabled" in r.body
+
+
+def test_lock_config_requires_lock_enabled_bucket(client):
+    client.make_bucket("unlocked")
+    r = client.request("GET", "/unlocked", query={"object-lock": ""})
+    assert r.status == 404
+    assert r.error_code == "ObjectLockConfigurationNotFoundError"
+    body = (
+        b"<ObjectLockConfiguration>"
+        b"<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+        b"</ObjectLockConfiguration>"
+    )
+    r = client.request(
+        "PUT", "/unlocked", query={"object-lock": ""}, body=body
+    )
+    assert r.status == 404
+
+
+def test_lock_default_retention_stamped(client):
+    _make_locked_bucket(client, "locked2")
+    cfg = (
+        b"<ObjectLockConfiguration>"
+        b"<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+        b"<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>"
+        b"<Days>1</Days></DefaultRetention></Rule>"
+        b"</ObjectLockConfiguration>"
+    )
+    r = client.request(
+        "PUT", "/locked2", query={"object-lock": ""}, body=cfg
+    )
+    assert r.status == 200
+    r = client.put_object("locked2", "obj", b"data")
+    assert r.status == 200
+    vid = r.headers.get("x-amz-version-id", "")
+    assert vid
+    # default rule stamped GOVERNANCE retention on the version
+    r = client.head_object("locked2", "obj")
+    assert r.headers.get("x-amz-object-lock-mode") == "GOVERNANCE"
+    r = client.request("GET", "/locked2/obj", query={"retention": ""})
+    assert r.status == 200 and b"GOVERNANCE" in r.body
+    # deleting the version without bypass is refused
+    r = client.delete_object_version("locked2", "obj", vid)
+    assert r.status == 400 and r.error_code == "ObjectLocked"
+    # governance bypass succeeds (root holds all permissions)
+    r = client.request(
+        "DELETE", "/locked2/obj", query={"versionId": vid},
+        headers={"x-amz-bypass-governance-retention": "true"},
+    )
+    assert r.status == 204
+
+
+def test_compliance_cannot_be_bypassed(client):
+    _make_locked_bucket(client, "locked3")
+    r = client.put_object(
+        "locked3", "obj", b"data",
+        headers={
+            "x-amz-object-lock-mode": "COMPLIANCE",
+            "x-amz-object-lock-retain-until-date": _future(1),
+        },
+    )
+    assert r.status == 200
+    vid = r.headers["x-amz-version-id"]
+    r = client.delete_object_version("locked3", "obj", vid)
+    assert r.status == 400 and r.error_code == "ObjectLocked"
+    r = client.request(
+        "DELETE", "/locked3/obj", query={"versionId": vid},
+        headers={"x-amz-bypass-governance-retention": "true"},
+    )
+    assert r.status == 400 and r.error_code == "ObjectLocked"
+    # weakening compliance retention is refused
+    weaker = (
+        b"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>"
+        + _future(30).encode()
+        + b"</RetainUntilDate></Retention>"
+    )
+    r = client.request(
+        "PUT", "/locked3/obj", query={"retention": ""}, body=weaker
+    )
+    assert r.status == 400 and r.error_code == "ObjectLocked"
+    # an unqualified DELETE still writes a delete marker (AWS allows)
+    r = client.delete_object("locked3", "obj")
+    assert r.status == 204
+    assert r.headers.get("x-amz-delete-marker") == "true"
+
+
+def test_legal_hold_blocks_delete(client):
+    _make_locked_bucket(client, "locked4")
+    r = client.put_object("locked4", "obj", b"data")
+    vid = r.headers["x-amz-version-id"]
+    r = client.request(
+        "PUT", "/locked4/obj", query={"legal-hold": ""},
+        body=b"<LegalHold><Status>ON</Status></LegalHold>",
+    )
+    assert r.status == 200
+    r = client.request("GET", "/locked4/obj", query={"legal-hold": ""})
+    assert r.status == 200 and b"<Status>ON</Status>" in r.body
+    r = client.request(
+        "DELETE", "/locked4/obj", query={"versionId": vid},
+        headers={"x-amz-bypass-governance-retention": "true"},
+    )
+    assert r.status == 400 and r.error_code == "ObjectLocked"
+    # releasing the hold unlocks it
+    r = client.request(
+        "PUT", "/locked4/obj", query={"legal-hold": ""},
+        body=b"<LegalHold><Status>OFF</Status></LegalHold>",
+    )
+    assert r.status == 200
+    r = client.delete_object_version("locked4", "obj", vid)
+    assert r.status == 204
+
+
+def test_lock_headers_on_unlocked_bucket_rejected(client):
+    client.make_bucket("nolock")
+    r = client.put_object(
+        "nolock", "obj", b"x",
+        headers={
+            "x-amz-object-lock-mode": "GOVERNANCE",
+            "x-amz-object-lock-retain-until-date": _future(1),
+        },
+    )
+    assert r.status == 400
+    assert r.error_code == "InvalidBucketObjectLockConfiguration"
+    # mode without date: invalid header pair
+    r = client.put_object(
+        "nolock", "obj", b"x",
+        headers={"x-amz-object-lock-mode": "GOVERNANCE"},
+    )
+    assert r.status == 400
+
+
+def test_retention_on_unlocked_bucket(client):
+    client.make_bucket("nolock2")
+    client.put_object("nolock2", "obj", b"x")
+    r = client.request("GET", "/nolock2/obj", query={"retention": ""})
+    assert r.status == 400
+    assert r.error_code == "InvalidBucketObjectLockConfiguration"
+
+
+def test_multi_delete_respects_worm(client):
+    _make_locked_bucket(client, "locked5")
+    r = client.put_object(
+        "locked5", "obj", b"data",
+        headers={
+            "x-amz-object-lock-mode": "COMPLIANCE",
+            "x-amz-object-lock-retain-until-date": _future(1),
+        },
+    )
+    vid = r.headers["x-amz-version-id"]
+    body = (
+        '<Delete><Object><Key>obj</Key><VersionId>'
+        + vid
+        + "</VersionId></Object></Delete>"
+    ).encode()
+    r = client.request(
+        "POST", "/locked5", query={"delete": ""}, body=body
+    )
+    assert r.status == 200
+    assert "ObjectLocked" in r.body.decode()
+
+
+def test_multipart_upload_respects_lock_defaults(client):
+    """Default retention must stamp multipart uploads too (code-review
+    finding: WORM bypass via CreateMultipartUpload)."""
+    _make_locked_bucket(client, "locked6")
+    cfg = (
+        b"<ObjectLockConfiguration>"
+        b"<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+        b"<Rule><DefaultRetention><Mode>COMPLIANCE</Mode>"
+        b"<Days>1</Days></DefaultRetention></Rule>"
+        b"</ObjectLockConfiguration>"
+    )
+    assert client.request(
+        "PUT", "/locked6", query={"object-lock": ""}, body=cfg
+    ).status == 200
+    r = client.request("POST", "/locked6/big", query={"uploads": ""})
+    uid = r.xml_text("UploadId")
+    r = client.request(
+        "PUT", "/locked6/big",
+        query={"partNumber": "1", "uploadId": uid}, body=b"p" * 16,
+    )
+    etag = r.headers["etag"].strip('"')
+    body = (
+        "<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+        f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>"
+    ).encode()
+    r = client.request(
+        "POST", "/locked6/big", query={"uploadId": uid}, body=body
+    )
+    assert r.status == 200
+    vid = r.headers["x-amz-version-id"]
+    r = client.head_object("locked6", "big")
+    assert r.headers.get("x-amz-object-lock-mode") == "COMPLIANCE"
+    r = client.request(
+        "DELETE", "/locked6/big", query={"versionId": vid},
+        headers={"x-amz-bypass-governance-retention": "true"},
+    )
+    assert r.status == 400 and r.error_code == "ObjectLocked"
+
+
+def test_versioning_suspension_blocked_on_lock_bucket(client):
+    _make_locked_bucket(client, "locked7")
+    r = client.request(
+        "PUT", "/locked7", query={"versioning": ""},
+        body=b"<VersioningConfiguration>"
+        b"<Status>Suspended</Status></VersioningConfiguration>",
+    )
+    assert r.status == 409 and r.error_code == "InvalidBucketState"
+
+
+def test_governance_upgrade_to_compliance_allowed(client):
+    """Strengthening GOVERNANCE -> COMPLIANCE needs no bypass."""
+    _make_locked_bucket(client, "locked8")
+    r = client.put_object(
+        "locked8", "obj", b"x",
+        headers={
+            "x-amz-object-lock-mode": "GOVERNANCE",
+            "x-amz-object-lock-retain-until-date": _future(1),
+        },
+    )
+    assert r.status == 200
+    stronger = (
+        b"<Retention><Mode>COMPLIANCE</Mode><RetainUntilDate>"
+        + _future(2).encode()
+        + b"</RetainUntilDate></Retention>"
+    )
+    r = client.request(
+        "PUT", "/locked8/obj", query={"retention": ""}, body=stronger
+    )
+    assert r.status == 200
+    r = client.request("GET", "/locked8/obj", query={"retention": ""})
+    assert b"COMPLIANCE" in r.body
+    # but shortening it back down is refused even with bypass
+    weaker = (
+        b"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>"
+        + _future(1).encode()
+        + b"</RetainUntilDate></Retention>"
+    )
+    r = client.request(
+        "PUT", "/locked8/obj", query={"retention": ""}, body=weaker,
+        headers={"x-amz-bypass-governance-retention": "true"},
+    )
+    assert r.status == 400 and r.error_code == "ObjectLocked"
+
+
+# -- SSE config routes ----------------------------------------------------
+
+
+def test_bucket_encryption_config(client):
+    client.make_bucket("enc")
+    r = client.request("GET", "/enc", query={"encryption": ""})
+    assert r.status == 404
+    assert (
+        r.error_code == "ServerSideEncryptionConfigurationNotFoundError"
+    )
+    cfg = (
+        b"<ServerSideEncryptionConfiguration><Rule>"
+        b"<ApplyServerSideEncryptionByDefault>"
+        b"<SSEAlgorithm>AES256</SSEAlgorithm>"
+        b"</ApplyServerSideEncryptionByDefault>"
+        b"</Rule></ServerSideEncryptionConfiguration>"
+    )
+    r = client.request(
+        "PUT", "/enc", query={"encryption": ""}, body=cfg
+    )
+    assert r.status == 200
+    r = client.request("GET", "/enc", query={"encryption": ""})
+    assert r.status == 200 and b"AES256" in r.body
+    r = client.request("DELETE", "/enc", query={"encryption": ""})
+    assert r.status == 204
+    r = client.request("GET", "/enc", query={"encryption": ""})
+    assert r.status == 404
+    # aws:kms is refused (only SSE-S3 honored)
+    kms = cfg.replace(b"AES256", b"aws:kms")
+    r = client.request(
+        "PUT", "/enc", query={"encryption": ""}, body=kms
+    )
+    assert r.status == 501
