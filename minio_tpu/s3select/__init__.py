@@ -1,0 +1,17 @@
+"""S3 Select: SQL over streamed CSV/JSON objects
+(pkg/s3select in the reference, 30k LoC; handler at
+cmd/object-handlers.go:91 SelectObjectContentHandler).
+
+Architecture here: a hand-rolled recursive-descent SQL parser and
+row-at-a-time evaluator (``sql``), streaming record readers (``csvio``,
+``jsonio``), AWS EventStream response framing (``message``), and the
+orchestrator (``engine``) that wires request XML -> reader -> evaluator
+-> framed response.  The evaluator is a pure host-side component - the
+reference's simdjson acceleration is CPU-bound parsing, not a
+TPU-shaped workload (SURVEY.md section 2.9: "host-side; not on the
+north-star path").
+"""
+
+from .engine import S3Select, SelectError
+
+__all__ = ["S3Select", "SelectError"]

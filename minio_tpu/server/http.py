@@ -1197,10 +1197,12 @@ class _Handler(BaseHTTPRequestHandler):
             root = ET.fromstring(body)
         except ET.ParseError:
             raise S3Error("MalformedXML") from None
+        from ..utils.xmlutil import strip_ns
+
         algos = [
             (el.text or "").strip()
             for el in root.iter()
-            if el.tag.rpartition("}")[2] == "SSEAlgorithm"
+            if strip_ns(el.tag) == "SSEAlgorithm"
         ]
         if algos != ["AES256"]:
             raise S3Error(
