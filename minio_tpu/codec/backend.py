@@ -216,10 +216,12 @@ def get_backend(name: "str | None" = None) -> CodecBackend:
 
 
 def _make(name: str) -> CodecBackend:
+    from .batcher import maybe_wrap
+
     if name == "cpu":
-        return CpuBackend()
+        return maybe_wrap(CpuBackend())
     if name == "tpu":
-        return TpuBackend()
+        return maybe_wrap(TpuBackend())
     if name == "auto":
         try:
             import jax
@@ -227,9 +229,9 @@ def _make(name: str) -> CodecBackend:
             # any jax backend (tpu or the CPU test platform) works; the
             # device path dispatches pallas-vs-portable internally
             jax.devices()
-            return TpuBackend()
+            return maybe_wrap(TpuBackend())
         except Exception:
-            return CpuBackend()
+            return maybe_wrap(CpuBackend())
     raise ValueError(f"unknown erasure backend {name!r}")
 
 

@@ -1,0 +1,219 @@
+"""Cross-request codec batching (SURVEY.md section 7 stage 8).
+
+Concurrent PutObject/GetObject requests each produce small codec calls
+(a few blocks per pass).  Launched independently they serialize on the
+device and pay per-launch overhead; the reference's analogue is the
+per-disk goroutine fan-out feeding one disk queue
+(cmd/erasure-encode.go:39-70).  Here ALL requests feed one device queue:
+
+* client threads submit jobs (encode / digest / reconstruct) and block;
+* a single dispatcher thread coalesces jobs with identical geometry
+  into one batched device call, then scatters results back;
+* a batch is flushed as soon as every currently-active client has
+  submitted (nobody left to wait for), or when ``deadline_s`` expires -
+  so a lone stream pays ~zero extra latency while 8 concurrent streams
+  coalesce into one launch (the "dynamic batch deadlines" risk note in
+  SURVEY.md section 7).
+
+Correctness is trivial: the grouped call is the same math on a
+concatenated batch axis, and results are split back by row counts.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from .backend import CodecBackend
+
+
+class _Job:
+    __slots__ = ("op", "key", "arrays", "result", "error", "done")
+
+    def __init__(self, op: str, key: tuple, arrays: tuple):
+        self.op = op
+        self.key = key
+        self.arrays = arrays
+        self.result = None
+        self.error: "BaseException | None" = None
+        self.done = threading.Event()
+
+
+class BatchingBackend(CodecBackend):
+    """Wrap any CodecBackend with cross-request batch coalescing."""
+
+    name = "batched"
+
+    def __init__(
+        self,
+        inner: CodecBackend,
+        deadline_s: float = 0.004,
+        max_batch_blocks: int = 256,
+    ):
+        self.inner = inner
+        self.deadline_s = deadline_s
+        self.max_batch_blocks = max_batch_blocks
+        self._cv = threading.Condition()
+        self._jobs: list[_Job] = []
+        # clients currently inside a codec call (submitted or about to)
+        self._active = 0
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="codec-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- client side ------------------------------------------------------
+
+    def _submit(self, op: str, key: tuple, arrays: tuple):
+        job = _Job(op, key, arrays)
+        with self._cv:
+            self._jobs.append(job)
+            self._cv.notify_all()
+        job.done.wait()
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    def encode(self, data, parity_shards):
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        B, k, L = data.shape
+        with self._cv:
+            self._active += 1
+        try:
+            return self._submit(
+                "encode", (k, L, parity_shards), (data,)
+            )
+        finally:
+            with self._cv:
+                self._active -= 1
+
+    def digest(self, shards):
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        B, n, L = shards.shape
+        with self._cv:
+            self._active += 1
+        try:
+            return self._submit("digest", (n, L), (shards,))
+        finally:
+            with self._cv:
+                self._active -= 1
+
+    def reconstruct(self, shards, present, data_shards, parity_shards):
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        B, n, L = shards.shape
+        key = (n, L, tuple(bool(b) for b in present), data_shards,
+               parity_shards)
+        with self._cv:
+            self._active += 1
+        try:
+            return self._submit("reconstruct", key, (shards,))
+        finally:
+            with self._cv:
+                self._active -= 1
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        self._thread.join(timeout=2)
+
+    # -- dispatcher -------------------------------------------------------
+
+    def _collect(self) -> "list[_Job]":
+        """Take a coalescible batch off the queue (holds no deadline
+        when every active client has already submitted)."""
+        import time
+
+        with self._cv:
+            while self._running and not self._jobs:
+                self._cv.wait(0.1)
+            if not self._running and not self._jobs:
+                return []
+            deadline = time.monotonic() + self.deadline_s
+            while True:
+                # flush when nobody else could still contribute, when
+                # the batch is big enough, or at the deadline
+                if len(self._jobs) >= self._active:
+                    break
+                if (
+                    sum(j.arrays[0].shape[0] for j in self._jobs)
+                    >= self.max_batch_blocks
+                ):
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            jobs, self._jobs = self._jobs, []
+            return jobs
+
+    def _loop(self) -> None:
+        while True:
+            jobs = self._collect()
+            if not jobs:
+                if not self._running:
+                    return
+                continue
+            groups: dict[tuple, list[_Job]] = {}
+            for j in jobs:
+                groups.setdefault((j.op, j.key), []).append(j)
+            for (op, key), group in groups.items():
+                try:
+                    self._run_group(op, key, group)
+                except BaseException as e:  # noqa: BLE001
+                    for j in group:
+                        j.error = e
+                        j.done.set()
+
+    def _run_group(self, op: str, key: tuple, group: "list[_Job]") -> None:
+        if len(group) == 1:
+            j = group[0]
+            j.result = self._call(op, key, j.arrays[0])
+            j.done.set()
+            return
+        rows = [j.arrays[0].shape[0] for j in group]
+        merged = np.concatenate([j.arrays[0] for j in group], axis=0)
+        total = merged.shape[0]
+        # device backends jit-compile per batch shape: arbitrary merged
+        # sizes would each pay a fresh XLA compile (seconds).  Pad the
+        # merged batch up to a power of two so the compile cache stays
+        # O(log max_batch) regardless of traffic mix.
+        padded = total
+        if getattr(self.inner, "name", "") == "tpu":
+            padded = 1 << (total - 1).bit_length()
+            if padded != total:
+                pad = np.zeros(
+                    (padded - total,) + merged.shape[1:], merged.dtype
+                )
+                merged = np.concatenate([merged, pad], axis=0)
+        out = self._call(op, key, merged)
+        # split along the batch axis and fulfill each job
+        offsets = np.cumsum([0] + rows)
+        for i, j in enumerate(group):
+            lo, hi = offsets[i], offsets[i + 1]
+            if op == "encode":
+                parity, digests = out
+                j.result = (parity[lo:hi], digests[lo:hi])
+            else:
+                j.result = out[lo:hi]
+            j.done.set()
+
+    def _call(self, op: str, key: tuple, arr):
+        if op == "encode":
+            return self.inner.encode(arr, key[2])
+        if op == "digest":
+            return self.inner.digest(arr)
+        if op == "reconstruct":
+            n, L, present, k, m = key
+            return self.inner.reconstruct(arr, present, k, m)
+        raise ValueError(f"unknown op {op}")
+
+
+def maybe_wrap(backend: CodecBackend) -> CodecBackend:
+    """Apply batching per MINIO_CODEC_BATCH (default on)."""
+    if os.environ.get("MINIO_CODEC_BATCH", "1") == "0":
+        return backend
+    return BatchingBackend(backend)
