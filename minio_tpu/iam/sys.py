@@ -20,11 +20,17 @@ import io
 import json
 import secrets as pysecrets
 import threading
+import time
 
 from ..objectlayer.api import META_BUCKET, ObjectNotFound
 from .policy import CANNED_POLICIES, Args, Policy, PolicyError
 
 IAM_PREFIX = "config/iam"
+
+# STS AssumeRole duration bounds (sts-handlers.go parseDurationSeconds)
+STS_MIN_DURATION_S = 900
+STS_MAX_DURATION_S = 7 * 24 * 3600
+STS_DEFAULT_DURATION_S = 3600
 
 
 class IAMError(Exception):
@@ -37,6 +43,14 @@ class UserNotFound(IAMError):
 
 class PolicyNotFound(IAMError):
     pass
+
+
+class GroupNotFound(IAMError):
+    pass
+
+
+class InvalidToken(IAMError):
+    """Temp-credential session token missing/mismatched/expired."""
 
 
 def generate_credentials() -> "tuple[str, str]":
@@ -61,9 +75,13 @@ class IAMSys:
         self._ol = object_layer
         self._mu = threading.RLock()
         # access_key -> {"secret": str, "policy": str, "status": str,
-        #               "parent": str (service accounts)}
+        #               "parent": str (service accounts),
+        #               "sts": bool, "expiration": unix ts,
+        #               "session_token": str, "session_policy": json}
         self._users: "dict[str, dict]" = {}
         self._policies: "dict[str, Policy]" = dict(CANNED_POLICIES)
+        # group name -> {"members": [ak...], "policy": str, "status": str}
+        self._groups: "dict[str, dict]" = {}
         # peer control plane: set in distributed mode so IAM edits
         # broadcast a reload to every node
         self.notifier = None
@@ -143,11 +161,30 @@ class IAMSys:
         return t
 
     def refresh(self) -> None:
-        """Reload users + policies from the store (iam.go Load)."""
+        """Reload users + groups + policies from the store (iam.go Load)."""
+        if self._ol is None:
+            # store-less IAM: in-memory maps ARE the source of truth;
+            # "reloading" would wipe them
+            return
         users = self._load_docs("users")
         policies = self._load_docs("policies")
+        groups = self._load_docs("groups")
+        now = time.time()
+        # temp credentials persist under their own kind with a TTL
+        # (iam-object-store stores STS creds so every node honors them)
+        for ak, u in self._load_docs("sts").items():
+            if u.get("expiration", 0) > now:
+                users.setdefault(ak, u)
         with self._mu:
+            # keep unexpired in-memory temp creds unconditionally: a
+            # concurrent assume_role may have inserted one after this
+            # refresh snapshotted the sts/ docs (and store-less IAM has
+            # no docs at all) - dropping it would orphan a live token
+            for ak, u in self._users.items():
+                if u.get("sts") and u.get("expiration", 0) > now:
+                    users.setdefault(ak, u)
             self._users = users
+            self._groups = groups
             self._policies = dict(CANNED_POLICIES)
             for name, doc in policies.items():
                 try:
@@ -164,7 +201,28 @@ class IAMSys:
             u = self._users.get(access_key)
             if u is None or u.get("status") == "disabled":
                 return None
+            if u.get("sts") and u.get("expiration", 0) <= time.time():
+                return None  # expired temp credential
             return u["secret"]
+
+    def validate_session_token(
+        self, access_key: str, token: "str | None"
+    ) -> None:
+        """Temp credentials must present their session token on every
+        request (x-amz-security-token); long-lived credentials must
+        not carry a foreign token (checkClaimsFromToken)."""
+        with self._mu:
+            u = self._users.get(access_key)
+        if u is None or not u.get("sts"):
+            if token:
+                raise InvalidToken(
+                    "security token used with a non-temporary credential"
+                )
+            return
+        if u.get("expiration", 0) <= time.time():
+            raise InvalidToken("temporary credential expired")
+        if not token or token != u.get("session_token"):
+            raise InvalidToken("security token mismatch")
 
     def is_owner(self, access_key: str) -> bool:
         return access_key == self.root_access_key
@@ -245,8 +303,156 @@ class IAMSys:
             return {
                 ak: {"policy": u.get("policy", ""), "status": u.get("status")}
                 for ak, u in self._users.items()
-                if not u.get("parent")
+                if not u.get("parent") and not u.get("sts")
             }
+
+    # -- STS temp credentials (cmd/sts-handlers.go AssumeRole) ------------
+
+    def assume_role(
+        self,
+        caller: str,
+        duration_s: "int | None" = None,
+        session_policy: "str | None" = None,
+    ) -> dict:
+        """Issue temp credentials bound to the caller's permissions.
+
+        The effective policy of the temp credential is the caller's
+        policy intersected with the optional session policy (both must
+        allow).  Returns the credential document incl. the session
+        token and expiration (unix seconds).
+        """
+        if duration_s is None:
+            duration_s = STS_DEFAULT_DURATION_S
+        if not (STS_MIN_DURATION_S <= duration_s <= STS_MAX_DURATION_S):
+            raise IAMError(
+                f"DurationSeconds {duration_s} out of range "
+                f"[{STS_MIN_DURATION_S}, {STS_MAX_DURATION_S}]"
+            )
+        if session_policy:
+            try:
+                Policy.from_json(session_policy)
+            except PolicyError as e:
+                raise IAMError(f"bad session policy: {e}") from None
+        with self._mu:
+            if caller != self.root_access_key:
+                u = self._users.get(caller)
+                if u is None or u.get("status") == "disabled":
+                    raise UserNotFound(caller)
+                if u.get("sts"):
+                    raise IAMError(
+                        "temporary credentials cannot assume roles"
+                    )
+                if u.get("parent"):
+                    # the reference refuses AssumeRole for service
+                    # accounts (sts-handlers.go IsServiceAccount check)
+                    raise IAMError(
+                        "service accounts cannot assume roles"
+                    )
+        ak, sk = generate_credentials()
+        token = pysecrets.token_urlsafe(48)
+        doc = {
+            "secret": sk,
+            "policy": "",
+            "status": "enabled",
+            "parent": caller,
+            "sts": True,
+            "expiration": time.time() + duration_s,
+            "session_token": token,
+            "session_policy": session_policy or "",
+        }
+        with self._mu:
+            self._users[ak] = doc
+        self._save_doc("sts", ak, doc)
+        return {"access_key": ak, **doc}
+
+    def purge_expired_sts(self) -> int:
+        """Drop expired temp credentials (lazy GC; returns count)."""
+        now = time.time()
+        with self._mu:
+            dead = [
+                ak
+                for ak, u in self._users.items()
+                if u.get("sts") and u.get("expiration", 0) <= now
+            ]
+            for ak in dead:
+                del self._users[ak]
+        for ak in dead:
+            self._delete_doc("sts", ak)
+        return len(dead)
+
+    # -- groups (iam.go AddUsersToGroup / SetGroupStatus / ...) -----------
+
+    def add_group_members(
+        self, group: str, members: "list[str]"
+    ) -> None:
+        """Create the group if needed and add members (AddUsersToGroup)."""
+        with self._mu:
+            for ak in members:
+                if ak not in self._users:
+                    raise UserNotFound(ak)
+            g = self._groups.setdefault(
+                group, {"members": [], "policy": "", "status": "enabled"}
+            )
+            for ak in members:
+                if ak not in g["members"]:
+                    g["members"].append(ak)
+            doc = dict(g)
+        self._save_doc("groups", group, doc)
+
+    def remove_group_members(
+        self, group: str, members: "list[str]"
+    ) -> None:
+        """Remove members; an emptied member list with no members arg
+        deletes the group (RemoveUsersFromGroup semantics)."""
+        with self._mu:
+            g = self._groups.get(group)
+            if g is None:
+                raise GroupNotFound(group)
+            if not members:
+                if g["members"]:
+                    raise IAMError("group not empty")
+                del self._groups[group]
+                doc = None
+            else:
+                g["members"] = [
+                    ak for ak in g["members"] if ak not in members
+                ]
+                doc = dict(g)
+        if doc is None:
+            self._delete_doc("groups", group)
+        else:
+            self._save_doc("groups", group, doc)
+
+    def set_group_policy(self, group: str, policy: str) -> None:
+        if policy:
+            self.get_policy(policy)
+        with self._mu:
+            g = self._groups.get(group)
+            if g is None:
+                raise GroupNotFound(group)
+            g["policy"] = policy
+            doc = dict(g)
+        self._save_doc("groups", group, doc)
+
+    def set_group_status(self, group: str, enabled: bool) -> None:
+        with self._mu:
+            g = self._groups.get(group)
+            if g is None:
+                raise GroupNotFound(group)
+            g["status"] = "enabled" if enabled else "disabled"
+            doc = dict(g)
+        self._save_doc("groups", group, doc)
+
+    def group_info(self, group: str) -> dict:
+        with self._mu:
+            g = self._groups.get(group)
+            if g is None:
+                raise GroupNotFound(group)
+            return dict(g)
+
+    def list_groups(self) -> "list[str]":
+        with self._mu:
+            return sorted(self._groups)
 
     # -- policy management ------------------------------------------------
 
@@ -276,24 +482,57 @@ class IAMSys:
 
     # -- authorization (iam.go IsAllowed) ---------------------------------
 
+    def _base_allowed(self, account: str, args: Args) -> bool:
+        """Combined identity decision: the account's attached policy OR
+        any enabled group's policy (iam.go policyDBGet aggregates user +
+        group policies; any allow wins)."""
+        with self._mu:
+            u = self._users.get(account)
+            if u is None or u.get("status") == "disabled":
+                return False
+            pnames = []
+            if u.get("policy"):
+                pnames.append(u["policy"])
+            for g in self._groups.values():
+                if (
+                    account in g.get("members", ())
+                    and g.get("status") != "disabled"
+                    and g.get("policy")
+                ):
+                    pnames.append(g["policy"])
+            policies = [
+                self._policies[p] for p in pnames if p in self._policies
+            ]
+        return any(p.is_allowed(args) for p in policies)
+
     def is_allowed(self, args: Args) -> bool:
         """Identity-policy decision for an authenticated account."""
         if self.is_owner(args.account):
             return True
         with self._mu:
             u = self._users.get(args.account)
-            if u is None or u.get("status") == "disabled":
-                return False
-            # service accounts inherit the parent's policy
-            parent = u.get("parent")
-            if parent:
-                if self.is_owner(parent):
-                    return True
-                u = self._users.get(parent)
-                if u is None or u.get("status") == "disabled":
-                    return False
-            pname = u.get("policy", "")
-            policy = self._policies.get(pname) if pname else None
-        if policy is None:
+        if u is None or u.get("status") == "disabled":
             return False
-        return policy.is_allowed(args)
+        if u.get("sts"):
+            if u.get("expiration", 0) <= time.time():
+                return False
+            # temp creds: parent's permissions INTERSECTED with the
+            # session policy (both must allow; sts-handlers.go claims)
+            sp = u.get("session_policy", "")
+            if sp:
+                try:
+                    if not Policy.from_json(sp).is_allowed(args):
+                        return False
+                except PolicyError:
+                    return False
+            parent = u.get("parent", "")
+            if self.is_owner(parent):
+                return True
+            return self._base_allowed(parent, args)
+        # service accounts inherit the parent's effective policy
+        parent = u.get("parent")
+        if parent:
+            if self.is_owner(parent):
+                return True
+            return self._base_allowed(parent, args)
+        return self._base_allowed(args.account, args)

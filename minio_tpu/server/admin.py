@@ -82,6 +82,27 @@ class AdminAPI:
         if route == ("POST", "service-account"):
             ak, sk = iam.add_service_account(_req(q, "parent"))
             return 200, _json({"accessKey": ak, "secretKey": sk})
+        # groups (admin-router.go update-group-members / group status)
+        if route == ("GET", "groups"):
+            return 200, _json(iam.list_groups())
+        if route == ("GET", "group"):
+            return 200, _json(iam.group_info(_req(q, "group")))
+        if route == ("PUT", "update-group-members"):
+            doc = _body_json(body)
+            members = doc.get("members", [])
+            if doc.get("isRemove"):
+                iam.remove_group_members(_req(q, "group"), members)
+            else:
+                iam.add_group_members(_req(q, "group"), members)
+            return 200, b"{}"
+        if route == ("PUT", "set-group-policy"):
+            iam.set_group_policy(_req(q, "group"), q.get("name", ""))
+            return 200, b"{}"
+        if route == ("PUT", "set-group-status"):
+            iam.set_group_status(
+                _req(q, "group"), q.get("status") == "enabled"
+            )
+            return 200, b"{}"
         if route == ("GET", "list-canned-policies"):
             return 200, _json(
                 {
@@ -190,10 +211,14 @@ def _req(q: "dict[str, str]", key: str) -> str:
 
 
 def map_admin_error(e: Exception) -> "S3Error | None":
+    from ..iam.sys import GroupNotFound
+
     if isinstance(e, UserNotFound):
         return S3Error("InvalidArgument", f"no such user: {e}")
     if isinstance(e, PolicyNotFound):
         return S3Error("InvalidArgument", f"no such policy: {e}")
+    if isinstance(e, GroupNotFound):
+        return S3Error("InvalidArgument", f"no such group: {e}")
     if isinstance(e, IAMError):
         return S3Error("InvalidArgument", str(e))
     return None

@@ -518,12 +518,43 @@ class _Handler(BaseHTTPRequestHandler):
                 self.command, path, query, dict(self.headers.items())
             )
             self._auth = ctx
+            # temp credentials must present their session token; static
+            # credentials must not carry one (checkClaimsFromToken)
+            if not ctx.anonymous:
+                from ..iam.sys import InvalidToken
+
+                token = self.headers.get(
+                    "x-amz-security-token"
+                ) or query.get("X-Amz-Security-Token", [""])[0]
+                try:
+                    self.s3.iam.validate_session_token(
+                        ctx.access_key, token or None
+                    )
+                except InvalidToken as e:
+                    raise S3Error("InvalidTokenId", str(e)) from None
             from . import admin as adminmod
 
             if path.startswith(adminmod.PREFIX + "/"):
                 return self._route_admin(
                     path[len(adminmod.PREFIX) + 1 :], query, ctx
                 )
+            # STS plane: POST / with a form body carrying Action
+            # (registerSTSRouter mounts on the root path)
+            if (
+                self.command == "POST"
+                and path == "/"
+                and (self.headers.get("Content-Type") or "").startswith(
+                    "application/x-www-form-urlencoded"
+                )
+            ):
+                from . import sts as stsmod
+
+                form = stsmod.parse_form(self._read_body())
+                if "Action" in form:
+                    self._action = f"STS.{form.get('Action', '')}"
+                    stsmod.handle_sts(self, form)
+                    self._finish_body()
+                    return
             self._authorize(path, query, ctx)
             self._dispatch(path, query)
         except Exception as e:  # noqa: BLE001

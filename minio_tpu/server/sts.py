@@ -1,0 +1,91 @@
+"""STS API (cmd/sts-handlers.go): AssumeRole on the root path.
+
+POST / with a form body ``Action=AssumeRole&Version=2011-06-15`` signed
+with SigV4 by an existing static credential; responds with temp
+credentials (access key, secret, session token, expiration).  The other
+AssumeRole* variants (WebIdentity/ClientGrants/LDAP) need external
+OIDC/LDAP providers; they are rejected with a proper STS error.
+"""
+
+from __future__ import annotations
+
+import datetime
+import urllib.parse
+import xml.sax.saxutils as sx
+
+from ..iam.sys import IAMError, UserNotFound
+from .s3errors import S3Error
+
+STS_VERSION = "2011-06-15"
+_NS = "https://sts.amazonaws.com/doc/2011-06-15/"
+
+
+def parse_form(body: bytes) -> "dict[str, str]":
+    return {
+        k: v[0]
+        for k, v in urllib.parse.parse_qs(
+            body.decode("utf-8", "replace"), keep_blank_values=True
+        ).items()
+    }
+
+
+def handle_sts(handler, form: "dict[str, str]") -> None:
+    """Dispatch one STS action for an authenticated caller."""
+    action = form.get("Action", "")
+    if action in (
+        "AssumeRoleWithWebIdentity",
+        "AssumeRoleWithClientGrants",
+        "AssumeRoleWithLDAPIdentity",
+    ):
+        raise S3Error(
+            "NotImplemented",
+            f"{action} requires an external identity provider",
+        )
+    if action != "AssumeRole":
+        raise S3Error("InvalidParameterValue", f"unknown Action {action!r}")
+    version = form.get("Version", "")
+    if version != STS_VERSION:
+        raise S3Error(
+            "InvalidParameterValue", f"Version must be {STS_VERSION}"
+        )
+    ctx = handler._auth
+    if ctx is None or ctx.anonymous:
+        raise S3Error("AccessDenied", "AssumeRole requires signed creds")
+    iam = handler.s3.iam
+    # the reference refuses AssumeRole for temp creds; root is allowed
+    duration = None
+    if form.get("DurationSeconds"):
+        try:
+            duration = int(form["DurationSeconds"])
+        except ValueError:
+            raise S3Error(
+                "InvalidParameterValue", "DurationSeconds"
+            ) from None
+    try:
+        cred = iam.assume_role(
+            ctx.access_key,
+            duration_s=duration,
+            session_policy=form.get("Policy") or None,
+        )
+    except UserNotFound:
+        raise S3Error("STSInvalidClientTokenId") from None
+    except IAMError as e:
+        raise S3Error("InvalidParameterValue", str(e)) from None
+    exp = datetime.datetime.fromtimestamp(
+        cred["expiration"], datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+    body = (
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        f'<AssumeRoleResponse xmlns="{_NS}">'
+        "<AssumeRoleResult>"
+        "<Credentials>"
+        f"<AccessKeyId>{sx.escape(cred['access_key'])}</AccessKeyId>"
+        f"<SecretAccessKey>{sx.escape(cred['secret'])}</SecretAccessKey>"
+        f"<SessionToken>{sx.escape(cred['session_token'])}</SessionToken>"
+        f"<Expiration>{exp}</Expiration>"
+        "</Credentials>"
+        "</AssumeRoleResult>"
+        "<ResponseMetadata/>"
+        "</AssumeRoleResponse>"
+    ).encode()
+    handler._respond(200, body)
