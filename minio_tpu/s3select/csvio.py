@@ -10,7 +10,7 @@ from __future__ import annotations
 import csv
 import io
 
-from .sql import MISSING, to_output
+from .sql import to_output
 
 
 class CSVArgs:
@@ -38,23 +38,25 @@ def read_records(stream, args: CSVArgs):
     text = io.TextIOWrapper(stream, encoding="utf-8", newline="")
     rd = "\n" if args.record_delimiter in ("\n", "\r\n") else args.record_delimiter
 
+    # quote-escape semantics: same char as the quote -> doubled quotes
+    # (csv doublequote mode); a distinct char -> escapechar mode
+    csv_opts = {
+        "delimiter": args.field_delimiter,
+        "quotechar": args.quote_character,
+    }
+    if args.quote_escape_character != args.quote_character:
+        csv_opts["doublequote"] = False
+        csv_opts["escapechar"] = args.quote_escape_character
+
     if rd != "\n":
         # uncommon delimiter: re-split manually, then parse each record
         data = text.read()
         lines = data.split(args.record_delimiter)
         if lines and lines[-1] == "":
             lines.pop()
-        reader = csv.reader(
-            lines,
-            delimiter=args.field_delimiter,
-            quotechar=args.quote_character,
-        )
+        reader = csv.reader(lines, **csv_opts)
     else:
-        reader = csv.reader(
-            text,
-            delimiter=args.field_delimiter,
-            quotechar=args.quote_character,
-        )
+        reader = csv.reader(text, **csv_opts)
 
     header: "list[str] | None" = None
     mode = args.file_header_info

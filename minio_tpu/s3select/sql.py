@@ -141,11 +141,6 @@ class Column(Expr):
         return MISSING
 
 
-class Star(Expr):
-    def eval(self, row):
-        return row
-
-
 def _num(v):
     """Coerce to a number for arithmetic/comparison, or None."""
     if isinstance(v, bool):
@@ -290,6 +285,17 @@ class Like(Expr):
     def __init__(self, expr, pattern, escape, negate):
         self.expr, self.pattern = expr, pattern
         self.escape, self.negate = escape, negate
+        # literal pattern/escape (the common case): compile ONCE, not
+        # per row on the scan hot path
+        self._compiled = None
+        if isinstance(pattern, Literal) and (
+            escape is None or isinstance(escape, Literal)
+        ):
+            esc = None
+            if escape is not None and not _is_null(escape.value):
+                esc = _to_str(escape.value)
+            if not _is_null(pattern.value):
+                self._compiled = self._regex(_to_str(pattern.value), esc)
 
     def _regex(self, pat: str, esc: "str | None"):
         out = []
@@ -311,15 +317,21 @@ class Like(Expr):
 
     def eval(self, row):
         v = self.expr.eval(row)
-        p = self.pattern.eval(row)
-        if _is_null(v) or _is_null(p):
+        if _is_null(v):
             return None
-        esc = None
-        if self.escape is not None:
-            e = self.escape.eval(row)
-            if not _is_null(e):
-                esc = _to_str(e)
-        hit = bool(self._regex(_to_str(p), esc).match(_to_str(v)))
+        if self._compiled is not None:
+            rx = self._compiled
+        else:
+            p = self.pattern.eval(row)
+            if _is_null(p):
+                return None
+            esc = None
+            if self.escape is not None:
+                e = self.escape.eval(row)
+                if not _is_null(e):
+                    esc = _to_str(e)
+            rx = self._regex(_to_str(p), esc)
+        hit = bool(rx.match(_to_str(v)))
         return (not hit) if self.negate else hit
 
     def walk(self):
@@ -770,9 +782,6 @@ class SelectStatement:
     def is_aggregate(self) -> bool:
         return bool(self.aggregates)
 
-    def _strip_alias(self, row: dict) -> dict:
-        return row
-
     def normalize_column(self, name: str) -> str:
         """Strip the table alias prefix from a column path."""
         alias = self.table_alias
@@ -818,38 +827,30 @@ class SelectStatement:
     def aggregate_result(self) -> dict:
         out = {}
         for i, p in enumerate(self.projections or []):
-            expr = p.expr
-            if isinstance(expr, Aggregate):
-                v = expr.result()
-            else:
-                # expression over aggregates, e.g. SUM(a)/COUNT(*)
-                v = _AggResultEval(expr).eval({})
-            out[p.alias or f"_{i + 1}"] = v
+            # replace every Aggregate node with its final value, then
+            # evaluate whatever expression wraps it (CAST, COALESCE,
+            # arithmetic over aggregates, ...)
+            out[p.alias or f"_{i + 1}"] = _resolve_aggregates(
+                p.expr
+            ).eval({})
         return out
 
 
-class _AggResultEval:
-    """Evaluate an expression tree where Aggregate nodes yield their
-    final results."""
-
-    def __init__(self, expr: Expr):
-        self.expr = expr
-
-    def eval(self, row):
-        return self._eval(self.expr, row)
-
-    def _eval(self, node: Expr, row):
-        if isinstance(node, Aggregate):
-            return node.result()
-        if isinstance(node, Arith):
-            saved_l, saved_r = node.left, node.right
-            node.left = Literal(self._eval(saved_l, row))
-            node.right = Literal(self._eval(saved_r, row))
-            try:
-                return node.eval(row)
-            finally:
-                node.left, node.right = saved_l, saved_r
-        return node.eval(row)
+def _resolve_aggregates(node: Expr) -> Expr:
+    """Rewrite Aggregate nodes into Literals of their final results so
+    the surrounding expression evaluates normally.  Runs once, after
+    the scan, so mutating the tree in place is safe."""
+    if isinstance(node, Aggregate):
+        return Literal(node.result())
+    for attr in ("left", "right", "expr", "lo", "hi", "pattern", "escape"):
+        child = getattr(node, attr, None)
+        if isinstance(child, Expr):
+            setattr(node, attr, _resolve_aggregates(child))
+    if isinstance(node, Call):
+        node.args = [_resolve_aggregates(a) for a in node.args]
+    if isinstance(node, In):
+        node.options = [_resolve_aggregates(o) for o in node.options]
+    return node
 
 
 def parse(expression: str) -> SelectStatement:
@@ -887,7 +888,9 @@ def parse(expression: str) -> SelectStatement:
             "FROM must name S3Object", "InvalidDataSource"
         )
     while p.accept_op("."):
-        p.next()  # json path steps on the table are accepted, ignored
+        step = p.next()  # json path steps on the table: accepted, ignored
+        if step.kind not in ("ident", "qident"):
+            raise SQLError("bad table path after FROM S3Object.")
     table_alias = ""
     if p.accept_kw("as"):
         at = p.next()

@@ -98,6 +98,33 @@ def test_aggregate_expression():
     assert out.decode().strip() == "29.5"
 
 
+def test_aggregates_inside_functions():
+    """CAST/COALESCE wrapping aggregates must read the final result
+    (code-review finding: the wrapper used to re-run accumulation)."""
+    out = _select("SELECT CAST(AVG(age) AS INTEGER) FROM S3Object")
+    assert out.decode().strip() == "29"
+    out = _select("SELECT COALESCE(SUM(age), 0) FROM S3Object")
+    assert out.decode().strip() == "118"
+
+
+def test_trailing_dot_is_parse_error():
+    with pytest.raises(sqlmod.SQLError):
+        sqlmod.parse("SELECT * FROM S3Object.")
+
+
+def test_custom_quote_escape_char():
+    data = b'name,quote\nalice,"say \\"hi\\" now"\n'
+    out = _select(
+        "SELECT quote FROM S3Object",
+        data=data,
+        input_xml=(
+            "<CSV><FileHeaderInfo>USE</FileHeaderInfo>"
+            "<QuoteEscapeCharacter>\\</QuoteEscapeCharacter></CSV>"
+        ),
+    )
+    assert out.decode().strip() == '"say ""hi"" now"'
+
+
 def test_between_in_like():
     assert _select(
         "SELECT name FROM S3Object WHERE age BETWEEN 26 AND 31"
