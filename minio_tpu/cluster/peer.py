@@ -151,6 +151,15 @@ class PeerRESTServer:
             iam.refresh()
         return {"ok": True}
 
+    def _load_config(self, q, body) -> dict:
+        """Re-read + apply the persisted KV config (the set-config-kv
+        cluster-wide reload, notification.go LoadConfig analogue)."""
+        if self.s3.object_layer is not None:
+            cfg = self.s3.config
+            cfg.reload()
+            cfg.apply()
+        return {"ok": True}
+
     def _get_locks(self, q, body) -> dict:
         if self.local_locker is None:
             return {"locks": []}
@@ -175,6 +184,7 @@ class PeerRESTServer:
         "loadbucketmetadata": _load_bucket_metadata,
         "deletebucketmetadata": _delete_bucket_metadata,
         "loadiam": _load_iam,
+        "loadconfig": _load_config,
         "getlocks": _get_locks,
         "verifyconfig": _verify_config,
     }
@@ -304,6 +314,9 @@ class PeerRESTClient:
     def server_info(self) -> dict:
         return self.call("serverinfo")
 
+    def load_config(self) -> None:
+        self.call("loadconfig", retry=False)
+
     def load_bucket_metadata(self, bucket: str) -> None:
         self.call("loadbucketmetadata", {"bucket": bucket}, retry=False)
 
@@ -364,6 +377,9 @@ class PeerNotifier:
 
     def iam_changed(self) -> None:
         self._fanout(lambda c: c.load_iam())
+
+    def config_changed(self) -> None:
+        self._fanout(lambda c: c.load_config())
 
     def _gather(self, fn, fallback):
         """Query every peer concurrently on the pool: the wall time for

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import io
+import os
 import json
 import threading
 import time
@@ -89,11 +90,14 @@ class DataCrawler:
         ensure_event_rules=None,
         sleep_every: int = 256,
         sleep_s: float = 0.05,
+        replication=None,
     ):
         self._ol = object_layer
         self._meta = bucket_meta
         self._interval = interval_s
         self._events = events
+        # ReplicationPool for the healReplication catch-up pass
+        self._replication = replication
         # server callback hydrating a bucket's notification rules
         # before we fire (http.py ensure_event_rules); without it a
         # freshly restarted server would drop every expiry event
@@ -269,6 +273,16 @@ class DataCrawler:
             pass
         bu = BucketUsage()
         seen = 0
+        # latest live versions - accumulated ONLY when a FIFO quota is
+        # configured (the list is O(objects); without a quota the crawl
+        # stays streaming)
+        from ..objectlayer import quota as quotamod
+
+        qcfg = quotamod.config_for(self._meta, bucket)
+        fifo = qcfg is not None and qcfg.quota_type == "fifo"
+        latest: list = []
+        repl = self._replication
+        repl_cfg = repl.config_for(bucket) if repl is not None else None
 
         def process_key(rows: list) -> None:
             """All versions of ONE key (journal order: newest first);
@@ -293,6 +307,18 @@ class DataCrawler:
                 if oi.is_latest and not oi.delete_marker:
                     bu.objects += 1
                     bu.size += oi.size
+                    if fifo:
+                        latest.append(oi)
+                    # replication catch-up: PENDING/FAILED never made
+                    # it to the target - queue it again
+                    if repl_cfg is not None:
+                        status = oi.user_defined.get(
+                            "x-amz-replication-status", ""
+                        )
+                        if status in (
+                            "PENDING", "FAILED"
+                        ) and repl_cfg.rule_for(oi.name):
+                            repl.queue(bucket, oi.name, oi.version_id)
 
         key_marker = vid_marker = ""
         group: list = []
@@ -317,7 +343,39 @@ class DataCrawler:
         if group:
             process_key(group)
         self._abort_stale_uploads(bucket, lc)
+        self._enforce_fifo_quota(bucket, bu, latest, versioned, suspended)
         return bu
+
+    def _enforce_fifo_quota(
+        self, bucket, bu, latest, versioned, suspended
+    ) -> None:
+        """FIFO quota: evict oldest objects until the bucket fits
+        (bucket-quota.go enforceFIFOQuota on the crawler pass)."""
+        from ..objectlayer import objectlock as olock, quota as quotamod
+
+        cfg = quotamod.config_for(self._meta, bucket)
+        if cfg is None or cfg.quota_type != "fifo":
+            return
+        over = bu.size - cfg.quota
+        if over <= 0:
+            return
+        for oi in sorted(latest, key=lambda o: o.mod_time_ns):
+            if over <= 0:
+                break
+            # WORM-protected versions are never evicted
+            # (enforceRetentionForDeletion guard in the reference)
+            if olock.retention_blocks_delete(oi.user_defined):
+                continue
+            try:
+                self._ol.delete_object(
+                    bucket, oi.name, oi.version_id,
+                    versioned=versioned, version_suspended=suspended,
+                )
+            except Exception:  # noqa: BLE001
+                continue
+            over -= oi.size
+            bu.size -= oi.size
+            bu.objects -= 1
 
     # -- lifecycle of the thread itself -----------------------------------
 
@@ -334,9 +392,21 @@ class DataCrawler:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def _effective_interval(self) -> float:
+        try:
+            return float(
+                os.environ.get("MINIO_TPU_CRAWL_INTERVAL_S")
+                or self._interval
+            )
+        except ValueError:
+            return self._interval
+
     def _run(self) -> None:
         # initial delay so boot IO settles (crawler waits a cycle)
-        while not self._stop.wait(self._interval):
+        # interval re-read each cycle: runtime-editable via admin
+        # set-config-kv (crawler.interval_s); malformed values must
+        # never kill this thread
+        while not self._stop.wait(self._effective_interval()):
             try:
                 self.crawl_once()
             except Exception:  # noqa: BLE001 - never kill the thread

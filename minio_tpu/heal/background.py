@@ -151,8 +151,19 @@ class HealRoutine:
                 )
             finally:
                 self.queue.task_done()
-            if self._throttle:
-                self._stop.wait(self._throttle)
+            import os
+
+            # config seam: runtime-editable via admin set-config-kv;
+            # a malformed value must never kill this thread
+            try:
+                throttle = float(
+                    os.environ.get("MINIO_TPU_HEAL_THROTTLE_S")
+                    or self._throttle
+                )
+            except ValueError:
+                throttle = self._throttle
+            if throttle:
+                self._stop.wait(throttle)
 
 
 class FreshDiskMonitor:

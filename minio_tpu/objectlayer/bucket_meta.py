@@ -40,6 +40,9 @@ class BucketMetadata:
     object_lock_xml: str = ""
     sse_config_xml: str = ""
     replication_xml: str = ""
+    # admin-registered remote replication targets (bucket-targets.go):
+    # JSON list of {endpoint, access_key, secret_key, target_bucket}
+    replication_targets_json: str = ""
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

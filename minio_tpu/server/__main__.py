@@ -312,6 +312,9 @@ def main(argv=None) -> int:
         nslock=nslock,
     )
     srv.object_layer = ol
+    # persisted KV config: load + apply before subsystems read their
+    # env seams (initSafeMode config load, server-main.go:526)
+    srv.config.apply()
     # store-backed IAM after the object layer is up (iam.go:419 Init)
     from ..iam.sys import IAMSys
 
@@ -336,6 +339,7 @@ def main(argv=None) -> int:
         ),
         events=srv.events,
         ensure_event_rules=srv.ensure_event_rules,
+        replication=srv.replication,
     ).start()
     si = ol.storage_info()
     print(

@@ -56,6 +56,90 @@ class AdminAPI:
             if crawler is None:
                 raise S3Error("ServerNotInitialized")
             return 200, _json(crawler.crawl_once().to_dict())
+        # bucket quota (admin SetBucketQuota / GetBucketQuotaConfig)
+        if route == ("GET", "get-bucket-quota"):
+            ol.get_bucket_info(_req(q, "bucket"))
+            raw = self.s3.bucket_meta.get(_req(q, "bucket")).quota_json
+            return 200, (raw.encode() if raw else b"{}")
+        if route == ("PUT", "set-bucket-quota"):
+            from ..objectlayer.quota import QuotaConfig, QuotaError
+
+            bucket = _req(q, "bucket")
+            ol.get_bucket_info(bucket)
+            if body.strip() in (b"", b"{}"):
+                self.s3.bucket_meta.update(bucket, quota_json="")
+                return 200, b"{}"
+            try:
+                cfg = QuotaConfig.from_json(body)
+            except QuotaError as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            self.s3.bucket_meta.update(
+                bucket, quota_json=cfg.to_json()
+            )
+            return 200, b"{}"
+        # replication remote targets (admin SetRemoteTarget)
+        if route == ("GET", "list-remote-targets"):
+            bucket = _req(q, "bucket")
+            ol.get_bucket_info(bucket)
+            raw = self.s3.bucket_meta.get(bucket).replication_targets_json
+            return 200, (raw.encode() if raw else b"[]")
+        if route == ("PUT", "set-remote-target"):
+            bucket = _req(q, "bucket")
+            ol.get_bucket_info(bucket)
+            doc = _body_json(body)
+            for field in ("endpoint", "access_key", "secret_key",
+                          "target_bucket"):
+                if not doc.get(field):
+                    raise S3Error(
+                        "InvalidArgument", f"missing {field}"
+                    )
+            raw = self.s3.bucket_meta.get(
+                bucket
+            ).replication_targets_json
+            docs = json.loads(raw) if raw else []
+            docs = [
+                d
+                for d in docs
+                if d.get("target_bucket") != doc["target_bucket"]
+            ] + [doc]
+            self.s3.bucket_meta.update(
+                bucket, replication_targets_json=json.dumps(docs)
+            )
+            return 200, _json(
+                {
+                    "arn": (
+                        "arn:minio:replication:::"
+                        + doc["target_bucket"]
+                    )
+                }
+            )
+        # runtime KV config (admin-router.go:89 set-config-kv family)
+        if route == ("GET", "get-config"):
+            return 200, _json(self.s3.config.dump())
+        if route == ("GET", "config-help"):
+            return 200, _json(self.s3.config.help(_req(q, "subsys")))
+        if route == ("PUT", "set-config-kv"):
+            from ..config import ConfigError
+
+            try:
+                self.s3.config.set_kvs(
+                    _req(q, "subsys"),
+                    _body_json(body),
+                    q.get("target", "_"),
+                )
+            except ConfigError as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            return 200, b"{}"
+        if route == ("DELETE", "del-config-kv"):
+            from ..config import ConfigError
+
+            try:
+                self.s3.config.del_kvs(
+                    _req(q, "subsys"), q.get("target", "_")
+                )
+            except ConfigError as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            return 200, b"{}"
         # IAM management
         iam = self.s3.iam
         if route == ("GET", "list-users"):

@@ -214,6 +214,30 @@ class S3Server:
         self._event_rules_loaded.discard(bucket)
 
     @property
+    def replication(self):
+        """Async replication pool, lazily started (bucket-replication)."""
+        rp = getattr(self, "_replication_pool", None)
+        if rp is None or rp.s3 is not self:
+            from ..replication.replicate import ReplicationPool
+
+            rp = ReplicationPool(self).start()
+            self._replication_pool = rp
+        return rp
+
+    @property
+    def config(self):
+        """Runtime KV config subsystem, lazily bound to the object
+        layer (cmd/config ConfigSys analogue)."""
+        cs = getattr(self, "_config_sys", None)
+        if cs is None or cs._ol is not self.object_layer:
+            from ..config import ConfigSys
+
+            cs = ConfigSys(self.object_layer)
+            self._config_sys = cs
+        cs.notifier = self.peer_notifier
+        return cs
+
+    @property
     def bucket_meta(self) -> BucketMetadataSys:
         """Bucket metadata subsystem, lazily bound once the object
         layer attaches (it persists through the layer)."""
@@ -1628,10 +1652,20 @@ class _Handler(BaseHTTPRequestHandler):
             if k.startswith("x-amz-meta-"):
                 meta[k] = v
         meta.update(self._put_lock_and_tag_meta(bucket, key))
+        from ..objectlayer import quota as quotamod
+
+        quotamod.enforce_put(self.s3, bucket, len(file_data))
+        replicate = self.s3.replication.should_replicate(bucket, key)
+        if replicate:
+            from ..replication.replicate import META_REPLICATION_STATUS
+
+            meta[META_REPLICATION_STATUS] = "PENDING"
         hreader = HashReader(io.BytesIO(file_data), len(file_data))
         info = self.s3.object_layer.put_object(
             bucket, key, hreader, len(file_data), meta
         )
+        if replicate:
+            self.s3.replication.queue(bucket, key, info.version_id)
         status = form.get("success_action_status", "204")
         from ..event.event import EventName
 
@@ -1858,16 +1892,26 @@ class _Handler(BaseHTTPRequestHandler):
         reader, size = self._open_body()
         if size > MAX_OBJECT_SIZE:
             raise S3Error("EntityTooLarge")
+        from ..objectlayer import quota as quotamod
+
+        quotamod.enforce_put(self.s3, bucket, size)
         hreader = self._hash_reader(reader, size)
         versioned, _ = self._versioning(bucket)
         meta = self._collect_user_metadata()
         meta.update(self._put_lock_and_tag_meta(bucket, key))
+        replicate = self.s3.replication.should_replicate(bucket, key)
+        if replicate:
+            from ..replication.replicate import META_REPLICATION_STATUS
+
+            meta[META_REPLICATION_STATUS] = "PENDING"
         # transparent compression (MINIO_TPU_COMPRESS) is decided inside
         # the object layer so POST-policy/multipart/copy share the seam
         info = self.s3.object_layer.put_object(
             bucket, key, hreader, size, meta,
             versioned=versioned,
         )
+        if replicate:
+            self.s3.replication.queue(bucket, key, info.version_id)
         hdrs = {"ETag": f'"{info.etag}"'}
         if info.version_id:
             hdrs["x-amz-version-id"] = info.version_id
@@ -1904,6 +1948,21 @@ class _Handler(BaseHTTPRequestHandler):
         # destination-bucket lock defaults / explicit lock headers and
         # REPLACE-directive tags stamp the new version
         lock_tag = self._put_lock_and_tag_meta(bucket, key)
+        # quota + replication apply to copies exactly like PUTs
+        # (code-review r4: copy must not bypass either)
+        from ..objectlayer import quota as quotamod
+
+        src_info = self.s3.object_layer.get_object_info(
+            src_bucket, src_key
+        )
+        quotamod.enforce_put(self.s3, bucket, src_info.size)
+        replicate = self.s3.replication.should_replicate(bucket, key)
+        if replicate:
+            from ..replication.replicate import META_REPLICATION_STATUS
+
+            lock_tag = {
+                **lock_tag, META_REPLICATION_STATUS: "PENDING",
+            }
         meta = (
             self._collect_user_metadata()
             if directive == "REPLACE"
@@ -1916,11 +1975,13 @@ class _Handler(BaseHTTPRequestHandler):
             src_bucket, src_key, bucket, key, meta, versioned=versioned
         )
         if meta is None and lock_tag:
-            # COPY directive keeps source metadata; lock stamps still
-            # apply to the fresh destination version
+            # COPY directive keeps source metadata; lock/replication
+            # stamps still apply to the fresh destination version
             self.s3.object_layer.update_object_meta(
                 bucket, key, lock_tag, info.version_id
             )
+        if replicate:
+            self.s3.replication.queue(bucket, key, info.version_id)
         hdrs = (
             {"x-amz-version-id": info.version_id}
             if info.version_id
@@ -1986,6 +2047,10 @@ class _Handler(BaseHTTPRequestHandler):
         # too (checkPutObjectLockAllowed in NewMultipartUploadHandler)
         meta = self._collect_user_metadata()
         meta.update(self._put_lock_and_tag_meta(bucket, key))
+        if self.s3.replication.should_replicate(bucket, key):
+            from ..replication.replicate import META_REPLICATION_STATUS
+
+            meta[META_REPLICATION_STATUS] = "PENDING"
         uid = self.s3.object_layer.new_multipart_upload(
             bucket, key, meta
         )
@@ -2006,6 +2071,9 @@ class _Handler(BaseHTTPRequestHandler):
         reader, size = self._open_body()
         if size > MAX_OBJECT_SIZE:
             raise S3Error("EntityTooLarge")
+        from ..objectlayer import quota as quotamod
+
+        quotamod.enforce_put(self.s3, bucket, size)
         hreader = self._hash_reader(reader, size)
         pi = self.s3.object_layer.put_object_part(
             bucket, key, uid, pnum, hreader, size
@@ -2031,6 +2099,8 @@ class _Handler(BaseHTTPRequestHandler):
         info = self.s3.object_layer.complete_multipart_upload(
             bucket, key, uid, parts, versioned=versioned
         )
+        if self.s3.replication.should_replicate(bucket, key):
+            self.s3.replication.queue(bucket, key, info.version_id)
         from ..event.event import EventName
 
         self._notify(

@@ -1,0 +1,149 @@
+"""Runtime KV config subsystem (cmd/config/config.go + admin
+set-config-kv routes + peer reload)."""
+
+import json
+import os
+
+import pytest
+
+from minio_tpu.config import ConfigError, ConfigSys
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("disks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return S3Client(server.endpoint)
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {
+        k: os.environ.get(k)
+        for k in ("MINIO_TPU_COMPRESS", "MINIO_TPU_CRAWL_INTERVAL_S")
+    }
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def test_defaults_and_layering(server):
+    cfg = ConfigSys(server.object_layer)
+    assert cfg.get("compression", "enable") == "off"
+    # env layer wins over default
+    os.environ["MINIO_TPU_COMPRESS"] = "on"
+    assert cfg.get("compression", "enable") == "on"
+    # persisted edit wins over env
+    cfg.set_kvs("compression", {"enable": "off"})
+    assert cfg.get("compression", "enable") == "off"
+    cfg.del_kvs("compression")
+    assert cfg.get("compression", "enable") == "on"
+    os.environ.pop("MINIO_TPU_COMPRESS")
+
+
+def test_unknown_keys_rejected(server):
+    cfg = ConfigSys(server.object_layer)
+    with pytest.raises(ConfigError):
+        cfg.set_kvs("nope", {"x": "1"})
+    with pytest.raises(ConfigError):
+        cfg.set_kvs("compression", {"bogus_key": "1"})
+    with pytest.raises(ConfigError):
+        cfg.get("compression", "bogus_key")
+
+
+def test_persistence_across_instances(server):
+    cfg = ConfigSys(server.object_layer)
+    cfg.set_kvs("crawler", {"interval_s": "123"})
+    cfg2 = ConfigSys(server.object_layer)
+    assert cfg2.get("crawler", "interval_s") == "123"
+    cfg.del_kvs("crawler")
+    cfg3 = ConfigSys(server.object_layer)
+    assert cfg3.get("crawler", "interval_s") == "60"
+
+
+def test_apply_pushes_env_seams(server):
+    cfg = ConfigSys(server.object_layer)
+    from minio_tpu.codec import compress
+
+    cfg.set_kvs("compression", {"enable": "on"})
+    assert compress.enabled()  # the runtime seam sees the edit
+    cfg.set_kvs("compression", {"enable": "off"})
+    assert not compress.enabled()
+    cfg.del_kvs("compression")
+
+
+def test_admin_config_routes(server, client):
+    r = client.request("GET", "/minio-tpu/admin/v1/get-config")
+    assert r.status == 200
+    doc = json.loads(r.body)
+    assert doc["compression"]["_"]["enable"] in ("on", "off")
+    assert "heal" in doc and "codec" in doc
+    # set-config-kv
+    r = client.request(
+        "PUT", "/minio-tpu/admin/v1/set-config-kv",
+        query={"subsys": "heal"},
+        body=json.dumps({"throttle_s": "2.5"}).encode(),
+    )
+    assert r.status == 200, r.body
+    r = client.request("GET", "/minio-tpu/admin/v1/get-config")
+    assert json.loads(r.body)["heal"]["_"]["throttle_s"] == "2.5"
+    assert os.environ.get("MINIO_TPU_HEAL_THROTTLE_S") == "2.5"
+    # del resets
+    r = client.request(
+        "DELETE", "/minio-tpu/admin/v1/del-config-kv",
+        query={"subsys": "heal"},
+    )
+    assert r.status == 200
+    r = client.request("GET", "/minio-tpu/admin/v1/get-config")
+    assert json.loads(r.body)["heal"]["_"]["throttle_s"] == "0"
+    # unknown subsystem -> 400
+    r = client.request(
+        "PUT", "/minio-tpu/admin/v1/set-config-kv",
+        query={"subsys": "bogus"}, body=b"{}",
+    )
+    assert r.status == 400
+    # help
+    r = client.request(
+        "GET", "/minio-tpu/admin/v1/config-help",
+        query={"subsys": "compression"},
+    )
+    assert b"transparent" in r.body
+
+
+def test_peer_reload_applies_config(server, tmp_path):
+    """A peer receiving loadconfig re-reads the persisted doc and
+    applies it (the cluster-wide reload semantics)."""
+    from minio_tpu.cluster import peer as peer_mod
+
+    peer_srv = peer_mod.PeerRESTServer(server, "sekrit")
+    # another node persisted an edit through the shared object layer
+    other = ConfigSys(server.object_layer)
+    other.set_kvs("crawler", {"interval_s": "77"})
+    os.environ.pop("MINIO_TPU_CRAWL_INTERVAL_S", None)
+    from minio_tpu.utils import jwt
+
+    token = jwt.sign({"sub": "peer"}, "sekrit", 60)
+    status, payload, _ = peer_srv.handle(
+        "loadconfig", {}, b"", {"Authorization": f"Bearer {token}"}
+    )
+    assert status == 200
+    assert server.config.get("crawler", "interval_s") == "77"
+    assert os.environ.get("MINIO_TPU_CRAWL_INTERVAL_S") == "77"
+    other.del_kvs("crawler")
+    server.config.reload()

@@ -1,0 +1,272 @@
+"""Bucket quota enforcement + async replication
+(cmd/bucket-quota.go, cmd/bucket-replication.go, crawler catch-up)."""
+
+import io
+import json
+
+import pytest
+
+from minio_tpu.crawler import DataCrawler
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.replication.replicate import META_REPLICATION_STATUS
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+REPL_XML = (
+    b"<ReplicationConfiguration>"
+    b"<Rule><Status>Enabled</Status><Priority>1</Priority>"
+    b"<Prefix></Prefix>"
+    b"<Destination><Bucket>arn:minio:replication:::{dst}</Bucket>"
+    b"</Destination></Rule>"
+    b"</ReplicationConfiguration>"
+)
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("disks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    yield srv
+    srv.replication.stop()
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return S3Client(server.endpoint)
+
+
+# -- quota ---------------------------------------------------------------
+
+
+def test_hard_quota_enforced(server, client):
+    client.make_bucket("quotabkt")
+    r = client.request(
+        "PUT", "/minio-tpu/admin/v1/set-bucket-quota",
+        query={"bucket": "quotabkt"},
+        body=json.dumps({"quota": 10_000, "quotatype": "hard"}).encode(),
+    )
+    assert r.status == 200, r.body
+    assert client.put_object("quotabkt", "a", b"x" * 6000).status == 200
+    # second object would exceed 10k
+    r = client.put_object("quotabkt", "b", b"x" * 6000)
+    assert r.status == 400
+    assert r.error_code == "XMinioAdminBucketQuotaExceeded"
+    # small object still fits
+    assert client.put_object("quotabkt", "c", b"x" * 1000).status == 200
+    # removing the quota unblocks
+    r = client.request(
+        "PUT", "/minio-tpu/admin/v1/set-bucket-quota",
+        query={"bucket": "quotabkt"}, body=b"{}",
+    )
+    assert r.status == 200
+    assert client.put_object("quotabkt", "b", b"x" * 6000).status == 200
+
+
+def test_get_quota_roundtrip(server, client):
+    client.make_bucket("quotabkt2")
+    client.request(
+        "PUT", "/minio-tpu/admin/v1/set-bucket-quota",
+        query={"bucket": "quotabkt2"},
+        body=json.dumps({"quota": 5, "quotatype": "fifo"}).encode(),
+    )
+    r = client.request(
+        "GET", "/minio-tpu/admin/v1/get-bucket-quota",
+        query={"bucket": "quotabkt2"},
+    )
+    assert json.loads(r.body) == {"quota": 5, "quotatype": "fifo"}
+
+
+def test_fifo_quota_evicts_oldest(server, client):
+    client.make_bucket("fifobkt")
+    client.request(
+        "PUT", "/minio-tpu/admin/v1/set-bucket-quota",
+        query={"bucket": "fifobkt"},
+        body=json.dumps({"quota": 8000, "quotatype": "fifo"}).encode(),
+    )
+    for i in range(4):  # 4 x 3000 = 12000 > 8000 -> evict two oldest
+        assert client.put_object(
+            "fifobkt", f"o{i}", bytes([i]) * 3000
+        ).status == 200
+    crawler = DataCrawler(
+        server.object_layer, server.bucket_meta, sleep_s=0
+    )
+    crawler.crawl_once()
+    names = [
+        o
+        for o in client.list_objects("fifobkt").xml_all("Key")
+    ]
+    # the two oldest were evicted
+    assert "o0" not in names and "o1" not in names
+    assert "o2" in names and "o3" in names
+
+
+# -- replication ---------------------------------------------------------
+
+
+def _enable_replication(server, client, src, dst):
+    client.make_bucket(src)
+    client.make_bucket(dst)
+    client.request(
+        "PUT", f"/{src}", query={"versioning": ""},
+        body=b"<VersioningConfiguration><Status>Enabled</Status>"
+        b"</VersioningConfiguration>",
+    )
+    r = client.request(
+        "PUT", f"/{src}", query={"replication": ""},
+        body=REPL_XML.replace(b"{dst}", dst.encode()),
+    )
+    assert r.status == 200, r.body
+
+
+def test_replication_config_requires_versioning(server, client):
+    client.make_bucket("noversrc")
+    r = client.request(
+        "PUT", "/noversrc", query={"replication": ""},
+        body=REPL_XML.replace(b"{dst}", b"anywhere"),
+    )
+    assert r.status == 400
+    assert r.error_code == "ReplicationSourceNotVersionedError"
+
+
+def test_put_replicates_to_local_target(server, client):
+    _enable_replication(server, client, "replsrc", "repldst")
+    r = client.put_object("replsrc", "doc.txt", b"replicate me")
+    assert r.status == 200
+    server.replication.drain()
+    # destination received the object
+    r = client.get_object("repldst", "doc.txt")
+    assert r.status == 200 and r.body == b"replicate me"
+    # source status flipped to COMPLETED
+    info = server.object_layer.get_object_info("replsrc", "doc.txt")
+    assert info.user_defined.get(META_REPLICATION_STATUS) == "COMPLETED"
+
+
+def test_failed_replication_caught_up_by_crawler(server, client):
+    _enable_replication(server, client, "replsrc2", "repldst2")
+    # break the target: delete the destination bucket
+    server.object_layer.delete_bucket("repldst2", force=True)
+    client.put_object("replsrc2", "x.bin", b"payload")
+    server.replication.drain()
+    info = server.object_layer.get_object_info("replsrc2", "x.bin")
+    assert info.user_defined.get(META_REPLICATION_STATUS) in (
+        "PENDING", "FAILED",
+    )
+    # restore the target; crawler catch-up requeues it
+    client.make_bucket("repldst2")
+    crawler = DataCrawler(
+        server.object_layer, server.bucket_meta, sleep_s=0,
+        replication=server.replication,
+    )
+    crawler.crawl_once()
+    server.replication.drain()
+    r = client.get_object("repldst2", "x.bin")
+    assert r.status == 200 and r.body == b"payload"
+    info = server.object_layer.get_object_info("replsrc2", "x.bin")
+    assert info.user_defined.get(META_REPLICATION_STATUS) == "COMPLETED"
+
+
+def test_remote_target_via_http(server, client, tmp_path_factory):
+    """Cross-cluster replication: a second server is the remote
+    target, reached over SigV4-signed HTTP."""
+    root = tmp_path_factory.mktemp("remote-disks")
+    disks = [XLStorage(str(root / f"d{i}")) for i in range(4)]
+    remote_ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
+    remote = S3Server(remote_ol, address="127.0.0.1:0").start()
+    try:
+        rc = S3Client(remote.endpoint)
+        rc.make_bucket("target-bkt")
+        _enable_replication(server, client, "xsrc", "xdst-unused")
+        r = client.request(
+            "PUT", "/minio-tpu/admin/v1/set-remote-target",
+            query={"bucket": "xsrc"},
+            body=json.dumps(
+                {
+                    "endpoint": remote.endpoint,
+                    "access_key": "minioadmin",
+                    "secret_key": "minioadmin",
+                    "target_bucket": "target-bkt",
+                }
+            ).encode(),
+        )
+        assert r.status == 200, r.body
+        client.put_object(
+            "xsrc", "cross.txt", b"over the wire",
+            headers={"x-amz-meta-color": "blue"},
+        )
+        server.replication.drain()
+        got = rc.get_object("target-bkt", "cross.txt")
+        assert got.status == 200 and got.body == b"over the wire"
+        assert got.headers.get("x-amz-meta-color") == "blue"
+    finally:
+        remote.shutdown()
+
+
+def test_copy_respects_quota_and_replication(server, client):
+    """CopyObject must not bypass quota or replication
+    (code-review r4 finding)."""
+    client.make_bucket("cpquota")
+    client.request(
+        "PUT", "/minio-tpu/admin/v1/set-bucket-quota",
+        query={"bucket": "cpquota"},
+        body=json.dumps({"quota": 4000, "quotatype": "hard"}).encode(),
+    )
+    client.make_bucket("cpsrcb")
+    client.put_object("cpsrcb", "big", b"z" * 3000)
+    r = client.request(
+        "PUT", "/cpquota/one",
+        headers={"x-amz-copy-source": "/cpsrcb/big"},
+    )
+    assert r.status == 200
+    r = client.request(
+        "PUT", "/cpquota/two",
+        headers={"x-amz-copy-source": "/cpsrcb/big"},
+    )
+    assert r.status == 400
+    assert r.error_code == "XMinioAdminBucketQuotaExceeded"
+    # replication via copy
+    _enable_replication(server, client, "cpreplsrc", "cprepldst")
+    r = client.request(
+        "PUT", "/cpreplsrc/copied",
+        headers={"x-amz-copy-source": "/cpsrcb/big"},
+    )
+    assert r.status == 200
+    server.replication.drain()
+    assert client.get_object("cprepldst", "copied").status == 200
+
+
+def test_bad_config_value_rejected(server, client):
+    """Non-numeric interval values are rejected at the API, never
+    reaching (and killing) the background threads."""
+    r = client.request(
+        "PUT", "/minio-tpu/admin/v1/set-config-kv",
+        query={"subsys": "crawler"},
+        body=json.dumps({"interval_s": "abc"}).encode(),
+    )
+    assert r.status == 400
+
+
+def test_prefix_rule_filters(server, client):
+    _enable_replication(server, client, "prefsrc", "prefdst")
+    # replace config with a prefix-scoped rule
+    xml = (
+        b"<ReplicationConfiguration><Rule>"
+        b"<Status>Enabled</Status><Priority>1</Priority>"
+        b"<Prefix>logs/</Prefix>"
+        b"<Destination><Bucket>prefdst</Bucket></Destination>"
+        b"</Rule></ReplicationConfiguration>"
+    )
+    client.request(
+        "PUT", "/prefsrc", query={"replication": ""}, body=xml
+    )
+    client.put_object("prefsrc", "logs/a.log", b"in scope")
+    client.put_object("prefsrc", "data/b.bin", b"out of scope")
+    server.replication.drain()
+    assert client.get_object("prefdst", "logs/a.log").status == 200
+    assert client.get_object("prefdst", "data/b.bin").status == 404
+    info = server.object_layer.get_object_info("prefsrc", "data/b.bin")
+    assert META_REPLICATION_STATUS not in info.user_defined
