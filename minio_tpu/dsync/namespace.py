@@ -134,30 +134,49 @@ class DistNamespaceLock:
     serialize through the lock plane."""
 
     def __init__(self, ds, source: str = ""):
+        from ..utils.dyntimeout import DynamicTimeout
         from .drwmutex import DRWMutex, Dsync  # noqa: F401 (typing aid)
 
         self._ds = ds
         self._source = source
+        # self-tuning lock-wait budgets (the reference wraps its object
+        # locks in newDynamicTimeout(30s, 1s))
+        self._rtimeout = DynamicTimeout(30.0, 1.0)
+        self._wtimeout = DynamicTimeout(30.0, 1.0)
 
     @contextlib.contextmanager
-    def read(self, volume: str, path: str, timeout: "float | None" = 30.0):
+    def read(self, volume: str, path: str, timeout: "float | None" = None):
+        import time as _t
+
         from .drwmutex import DRWMutex
 
+        if timeout is None:
+            timeout = self._rtimeout.timeout
         m = DRWMutex(self._ds, f"{volume}/{path}")
+        t0 = _t.monotonic()
         if not m.get_rlock(self._source, timeout):
+            self._rtimeout.log_failure()
             raise LockTimeout(f"{volume}/{path}")
+        self._rtimeout.log_success(_t.monotonic() - t0)
         try:
             yield
         finally:
             m.runlock()
 
     @contextlib.contextmanager
-    def write(self, volume: str, path: str, timeout: "float | None" = 30.0):
+    def write(self, volume: str, path: str, timeout: "float | None" = None):
+        import time as _t
+
         from .drwmutex import DRWMutex
 
+        if timeout is None:
+            timeout = self._wtimeout.timeout
         m = DRWMutex(self._ds, f"{volume}/{path}")
+        t0 = _t.monotonic()
         if not m.get_lock(self._source, timeout):
+            self._wtimeout.log_failure()
             raise LockTimeout(f"{volume}/{path}")
+        self._wtimeout.log_success(_t.monotonic() - t0)
         try:
             yield
         finally:

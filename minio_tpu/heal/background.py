@@ -201,8 +201,20 @@ class FreshDiskMonitor:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def _effective_interval(self) -> float:
+        import os
+
+        try:
+            v = float(
+                os.environ.get("MINIO_TPU_FRESH_DISK_INTERVAL_S")
+                or self._interval
+            )
+        except ValueError:
+            return self._interval
+        return v if v >= 1.0 else max(self._interval, 1.0)
+
     def _run(self) -> None:
-        while not self._stop.wait(self._interval):
+        while not self._stop.wait(self._effective_interval()):
             try:
                 self.scan_once()
             except Exception:  # noqa: BLE001
@@ -226,16 +238,21 @@ class FreshDiskMonitor:
                 for d_idx, disk in enumerate(eset.disks):
                     if disk is None:
                         continue
+                    # probe THROUGH the DiskIDCheck wrapper's inner
+                    # disk: the wrapper (rightly) fails every op on an
+                    # unformatted drive, but this monitor's whole job
+                    # is resurrecting exactly those drives
+                    raw = getattr(disk, "unwrapped", disk)
                     # stamped at boot (load_or_init_format hole fill):
                     # still needs its set swept
-                    if getattr(disk, "_freshly_stamped", False):
-                        disk._freshly_stamped = False
+                    if getattr(raw, "_freshly_stamped", False):
+                        raw._freshly_stamped = False
                         fresh.append(d_idx)
                         continue
-                    if not disk.is_local() or not disk.is_online():
+                    if not raw.is_local() or not raw.is_online():
                         continue
                     try:
-                        fmt = read_format(disk)
+                        fmt = read_format(raw)
                     except Exception:  # noqa: BLE001
                         continue  # corrupt format: operator decision
                     if fmt is not None:
@@ -244,7 +261,7 @@ class FreshDiskMonitor:
                     # (write_format recreates .sys itself)
                     try:
                         write_format(
-                            disk,
+                            raw,
                             FormatErasure(
                                 id=ref.id,
                                 this=ref.sets[s_idx][d_idx],

@@ -97,9 +97,22 @@ def build_cluster(
             init_allowed=init_allowed,
             timeout_s=format_timeout_s,
         )
+        # per-op disk identity validation on local drives
+        # (xl-storage-disk-id-check.go): a swapped drive fails fast
+        from ..storage.diskcheck import DiskIDCheck
+
+        guarded = []
+        for i, d in enumerate(ordered):
+            if d is not None and d.is_local():
+                s_idx, d_idx = divmod(i, drives_per_set)
+                guarded.append(
+                    DiskIDCheck(d, ref_fmt.sets[s_idx][d_idx])
+                )
+            else:
+                guarded.append(d)
         zones.append(
             ErasureSets(
-                ordered,
+                guarded,
                 set_count,
                 drives_per_set,
                 parity_blocks=parity,
@@ -312,6 +325,19 @@ def main(argv=None) -> int:
         nslock=nslock,
     )
     srv.object_layer = ol
+    # once formats are known, the storage REST plane serves the
+    # DiskIDCheck-wrapped disks too: peer I/O must not write onto a
+    # swapped drive either (xl-storage-disk-id-check.go applies to the
+    # server side of the plane)
+    from ..storage.diskcheck import DiskIDCheck as _DIC
+
+    guarded_map = {}
+    for zone in ol.zones:
+        for eset in zone.sets:
+            for d in eset.disks:
+                if isinstance(d, _DIC):
+                    guarded_map[d.unwrapped.root] = d
+    storage_rest.guard_disks(guarded_map)
     # persisted KV config: load + apply before subsystems read their
     # env seams (initSafeMode config load, server-main.go:526)
     srv.config.apply()

@@ -23,6 +23,14 @@ class StorageRESTServer:
         self._disks = {d.root: d for d in disks}
         self._secret = secret
 
+    def guard_disks(self, guarded: dict) -> None:
+        """Swap served disks for their DiskIDCheck wrappers once the
+        format is known (peer I/O must not bypass the per-op identity
+        validation; code-review r4).  ``guarded`` maps root -> wrapper."""
+        for root, wrapper in guarded.items():
+            if root in self._disks:
+                self._disks[root] = wrapper
+
     def authenticate(self, headers: dict) -> None:
         authz = headers.get("authorization", "")
         if not authz.startswith("Bearer "):
