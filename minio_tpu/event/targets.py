@@ -136,22 +136,65 @@ class MemoryTarget:
 
 def targets_from_env(environ=None) -> "list":
     """Build the target list from MINIO_TPU_NOTIFY_* variables
-    (cmd/config/notify/parse.go GetNotifyWebhook)."""
+    (cmd/config/notify/parse.go GetNotifyWebhook and siblings).
+
+    Any target gains at-least-once disk buffering when
+    ``MINIO_TPU_NOTIFY_<KIND>_QUEUE_DIR_<ID>`` is set (the reference's
+    per-target queueStore)."""
     env = os.environ if environ is None else environ
     out: list = []
     for key, val in sorted(env.items()):
-        if key.startswith("MINIO_TPU_NOTIFY_WEBHOOK_ENABLE_"):
-            if val != "on":
-                continue
-            tid = key[len("MINIO_TPU_NOTIFY_WEBHOOK_ENABLE_"):]
-            ep = env.get(f"MINIO_TPU_NOTIFY_WEBHOOK_ENDPOINT_{tid}", "")
-            if ep:
-                out.append(WebhookTarget(tid, ep))
-        elif key.startswith("MINIO_TPU_NOTIFY_LOGFILE_ENABLE_"):
-            if val != "on":
-                continue
-            tid = key[len("MINIO_TPU_NOTIFY_LOGFILE_ENABLE_"):]
-            path = env.get(f"MINIO_TPU_NOTIFY_LOGFILE_PATH_{tid}", "")
-            if path:
-                out.append(LogFileTarget(tid, path))
+        if not key.startswith("MINIO_TPU_NOTIFY_") or "_ENABLE_" not in key:
+            continue
+        if val != "on":
+            continue
+        prefix, _, tid = key.partition("_ENABLE_")
+        kind = prefix[len("MINIO_TPU_NOTIFY_"):]
+        target = None
+        try:
+            if kind == "WEBHOOK":
+                ep = env.get(f"MINIO_TPU_NOTIFY_WEBHOOK_ENDPOINT_{tid}", "")
+                if ep:
+                    target = WebhookTarget(tid, ep)
+            elif kind == "LOGFILE":
+                path = env.get(f"MINIO_TPU_NOTIFY_LOGFILE_PATH_{tid}", "")
+                if path:
+                    target = LogFileTarget(tid, path)
+            elif kind == "REDIS":
+                from .brokers import RedisTarget
+
+                addr = env.get(f"MINIO_TPU_NOTIFY_REDIS_ADDRESS_{tid}", "")
+                if addr:
+                    target = RedisTarget(
+                        tid, addr,
+                        key=env.get(
+                            f"MINIO_TPU_NOTIFY_REDIS_KEY_{tid}",
+                            "minioevents",
+                        ),
+                        password=env.get(
+                            f"MINIO_TPU_NOTIFY_REDIS_PASSWORD_{tid}", ""
+                        ),
+                    )
+            elif kind == "NATS":
+                from .brokers import NATSTarget
+
+                addr = env.get(f"MINIO_TPU_NOTIFY_NATS_ADDRESS_{tid}", "")
+                if addr:
+                    target = NATSTarget(
+                        tid, addr,
+                        subject=env.get(
+                            f"MINIO_TPU_NOTIFY_NATS_SUBJECT_{tid}",
+                            "minioevents",
+                        ),
+                    )
+        except TargetError:
+            continue  # malformed config: skip this target
+        if target is None:
+            continue
+        qdir = env.get(f"MINIO_TPU_NOTIFY_{kind}_QUEUE_DIR_{tid}", "")
+        if qdir:
+            from .queuestore import QueuedTarget
+
+            target = QueuedTarget(target, qdir)
+        out.append(target)
     return out

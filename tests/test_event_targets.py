@@ -1,0 +1,271 @@
+"""Event target tier: persistent queuestore + Redis/NATS/Kafka sinks
+(pkg/event/target/queuestore.go, redis.go, nats.go, kafka.go).
+
+Redis and NATS are tested against in-process socket servers speaking
+the real wire protocols."""
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from minio_tpu.event.brokers import KafkaTarget, NATSTarget, RedisTarget
+from minio_tpu.event.queuestore import QueuedTarget, QueueStore, StoreFull
+from minio_tpu.event.targets import MemoryTarget, TargetError, targets_from_env
+
+RECORD = {"EventName": "s3:ObjectCreated:Put", "Key": "b/k", "Records": []}
+
+
+class FlakyTarget:
+    """Fails until .up is True; counts deliveries."""
+
+    def __init__(self):
+        self.id = "flaky"
+        self.arn = "arn:minio:sqs::flaky:test"
+        self.up = False
+        self.records = []
+
+    def send(self, record):
+        if not self.up:
+            raise TargetError("down")
+        self.records.append(record)
+
+    def close(self):
+        pass
+
+
+# -- queue store ----------------------------------------------------------
+
+
+def test_store_fifo_roundtrip(tmp_path):
+    st = QueueStore(str(tmp_path / "q"))
+    keys = [st.put({"n": i}) for i in range(5)]
+    assert st.count() == 5
+    assert st.list() == sorted(keys)
+    assert [st.get(k)["n"] for k in st.list()] == [0, 1, 2, 3, 4]
+    st.delete(keys[0])
+    assert st.count() == 4
+
+
+def test_store_limit(tmp_path):
+    st = QueueStore(str(tmp_path / "q"), limit=3)
+    for i in range(3):
+        st.put({"n": i})
+    with pytest.raises(StoreFull):
+        st.put({"n": 99})
+
+
+def test_queued_target_delivers_after_recovery(tmp_path):
+    inner = FlakyTarget()
+    qt = QueuedTarget(
+        inner, str(tmp_path / "q"), retry_interval_s=0.05
+    )
+    try:
+        for i in range(4):
+            qt.send({"n": i})  # all parked (target down)
+        assert qt.store.count() == 4
+        assert inner.records == []
+        inner.up = True
+        deadline = time.monotonic() + 5
+        while qt.store.count() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert [r["n"] for r in inner.records] == [0, 1, 2, 3]
+    finally:
+        qt.close()
+
+
+def test_queued_target_preserves_order_with_backlog(tmp_path):
+    inner = FlakyTarget()
+    qt = QueuedTarget(
+        inner, str(tmp_path / "q"), retry_interval_s=999
+    )
+    try:
+        qt.send({"n": 0})  # parked
+        inner.up = True
+        qt.send({"n": 1})  # must queue BEHIND the backlog
+        assert inner.records == []
+        assert qt.store.count() == 2
+        qt.replay_once()
+        assert [r["n"] for r in inner.records] == [0, 1]
+    finally:
+        qt.close()
+
+
+def test_queued_target_survives_restart(tmp_path):
+    inner = FlakyTarget()
+    qdir = str(tmp_path / "q")
+    qt = QueuedTarget(inner, qdir, retry_interval_s=999)
+    qt.send({"n": 7})
+    qt.close()
+    # "restart": a new wrapper over the same directory
+    inner2 = FlakyTarget()
+    inner2.up = True
+    qt2 = QueuedTarget(inner2, qdir, retry_interval_s=999)
+    try:
+        assert qt2.replay_once() == 1
+        assert inner2.records[0]["n"] == 7
+    finally:
+        qt2.close()
+
+
+# -- redis (real RESP over a fake server) ---------------------------------
+
+
+class _FakeRedis(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        self.pushed = []
+        super().__init__(("127.0.0.1", 0), _FakeRedisHandler)
+
+
+class _FakeRedisHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line or not line.startswith(b"*"):
+                return
+            nargs = int(line[1:])
+            args = []
+            for _ in range(nargs):
+                ln = self.rfile.readline()  # $N
+                n = int(ln[1:])
+                args.append(self.rfile.read(n))
+                self.rfile.read(2)
+            cmd = args[0].upper()
+            if cmd == b"RPUSH":
+                self.server.pushed.append((args[1], args[2]))
+                self.wfile.write(b":%d\r\n" % len(self.server.pushed))
+            elif cmd == b"AUTH":
+                self.wfile.write(b"+OK\r\n")
+            else:
+                self.wfile.write(b"-ERR unknown\r\n")
+            self.wfile.flush()
+
+
+def test_redis_target_rpush():
+    srv = _FakeRedis()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = srv.server_address
+        target = RedisTarget("r1", f"{host}:{port}", key="evts")
+        target.send(RECORD)
+        target.send(RECORD)
+        target.close()
+        assert len(srv.pushed) == 2
+        key, body = srv.pushed[0]
+        assert key == b"evts"
+        assert json.loads(body)["EventName"] == "s3:ObjectCreated:Put"
+    finally:
+        srv.shutdown()
+
+
+def test_redis_target_down_raises():
+    target = RedisTarget("r2", "127.0.0.1:1", timeout=0.2)
+    with pytest.raises(TargetError):
+        target.send(RECORD)
+
+
+# -- nats (real text protocol over a fake server) -------------------------
+
+
+class _FakeNATS(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self):
+        self.published = []
+        super().__init__(("127.0.0.1", 0), _FakeNATSHandler)
+
+
+class _FakeNATSHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        self.wfile.write(b'INFO {"server_id":"fake"}\r\n')
+        self.wfile.flush()
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            if line.startswith(b"CONNECT"):
+                continue
+            if line.startswith(b"PUB"):
+                parts = line.split()
+                subject, size = parts[1], int(parts[2])
+                payload = self.rfile.read(size)
+                self.rfile.read(2)
+                self.server.published.append((subject, payload))
+            elif line.startswith(b"PING"):
+                self.wfile.write(b"PONG\r\n")
+                self.wfile.flush()
+
+
+def test_nats_target_pub():
+    srv = _FakeNATS()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = srv.server_address
+        target = NATSTarget("n1", f"{host}:{port}", subject="evts")
+        target.send(RECORD)
+        target.close()
+        assert len(srv.published) == 1
+        subject, payload = srv.published[0]
+        assert subject == b"evts"
+        assert json.loads(payload)["Key"] == "b/k"
+    finally:
+        srv.shutdown()
+
+
+# -- kafka (injectable producer) ------------------------------------------
+
+
+class _FakeProducer:
+    def __init__(self):
+        self.messages = []
+
+    def produce(self, topic, key, value):
+        self.messages.append((topic, key, value))
+
+    def close(self):
+        pass
+
+
+def test_kafka_target_produce():
+    prod = _FakeProducer()
+    target = KafkaTarget("k1", "events-topic", producer=prod)
+    target.send(RECORD)
+    assert prod.messages[0][0] == "events-topic"
+    assert prod.messages[0][1] == b"b/k"
+    target.close()
+    # unconfigured producer fails loudly (queued by the store wrapper)
+    with pytest.raises(TargetError):
+        KafkaTarget("k2", "t").send(RECORD)
+
+
+# -- env wiring -----------------------------------------------------------
+
+
+def test_targets_from_env_brokers_and_store(tmp_path):
+    env = {
+        "MINIO_TPU_NOTIFY_REDIS_ENABLE_R": "on",
+        "MINIO_TPU_NOTIFY_REDIS_ADDRESS_R": "127.0.0.1:6379",
+        "MINIO_TPU_NOTIFY_NATS_ENABLE_N": "on",
+        "MINIO_TPU_NOTIFY_NATS_ADDRESS_N": "127.0.0.1:4222",
+        "MINIO_TPU_NOTIFY_NATS_QUEUE_DIR_N": str(tmp_path / "natsq"),
+        "MINIO_TPU_NOTIFY_WEBHOOK_ENABLE_W": "on",
+        "MINIO_TPU_NOTIFY_WEBHOOK_ENDPOINT_W": "http://127.0.0.1:9/x",
+    }
+    targets = targets_from_env(env)
+    arns = {t.arn for t in targets}
+    assert "arn:minio:sqs::R:redis" in arns
+    assert "arn:minio:sqs::N:nats" in arns
+    assert "arn:minio:sqs::W:webhook" in arns
+    nats = next(t for t in targets if t.arn.endswith(":nats"))
+    assert isinstance(nats, QueuedTarget)  # store-wrapped
+    for t in targets:
+        t.close()
