@@ -242,8 +242,10 @@ class PeerRESTClient:
     def _conn(self) -> http.client.HTTPConnection:
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = http.client.HTTPConnection(
-                self.host, self.port, timeout=self._timeout
+            from ..utils import tlsconf
+
+            c = tlsconf.client_connection(
+                self.host, self.port, self._timeout
             )
             self._local.conn = c
         return c

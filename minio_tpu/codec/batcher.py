@@ -213,7 +213,15 @@ class BatchingBackend(CodecBackend):
 
 
 def maybe_wrap(backend: CodecBackend) -> CodecBackend:
-    """Apply batching per MINIO_CODEC_BATCH (default on)."""
-    if os.environ.get("MINIO_CODEC_BATCH", "1") == "0":
+    """Apply batching per MINIO_CODEC_BATCH (default on; "0"/"off"
+    disable - the admin config seam writes on/off)."""
+    if os.environ.get("MINIO_CODEC_BATCH", "on").lower() in ("0", "off"):
         return backend
-    return BatchingBackend(backend)
+    deadline_ms = 4.0
+    try:
+        deadline_ms = float(
+            os.environ.get("MINIO_CODEC_BATCH_DEADLINE_MS") or 4.0
+        )
+    except ValueError:
+        pass
+    return BatchingBackend(backend, deadline_s=deadline_ms / 1e3)

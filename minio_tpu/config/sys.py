@@ -89,18 +89,17 @@ register_default_kvs(
     {"level": "debug|info|warning|error"},
 )
 
-# keys whose values must parse as numbers (a bad value written to the
-# env seam would otherwise kill the background thread reading it)
-_NUMERIC_KEYS = frozenset(
-    {
-        ("heal", "throttle_s"),
-        ("heal", "fresh_disk_interval_s"),
-        ("crawler", "interval_s"),
-        ("api", "requests_max"),
-        ("api", "requests_deadline_s"),
-        ("codec", "batch_deadline_ms"),
-    }
-)
+# key -> (parser, min_value): values must parse and clear the floor -
+# a bad value written to the env seam would otherwise kill or busy-loop
+# the background thread reading it
+_NUMERIC_KEYS: "dict[tuple[str, str], tuple] " = {
+    ("heal", "throttle_s"): (float, 0.0),
+    ("heal", "fresh_disk_interval_s"): (float, 1.0),
+    ("crawler", "interval_s"): (float, 1.0),
+    ("api", "requests_max"): (int, 0),
+    ("api", "requests_deadline_s"): (float, 0.1),
+    ("codec", "batch_deadline_ms"): (float, 0.0),
+}
 
 # config key -> the env var its runtime seam reads
 _ENV_SEAMS: "dict[tuple[str, str], str]" = {
@@ -112,6 +111,7 @@ _ENV_SEAMS: "dict[tuple[str, str], str]" = {
     ("api", "requests_deadline_s"): "MINIO_TPU_REQUESTS_DEADLINE_S",
     ("codec", "backend"): "MINIO_ERASURE_BACKEND",
     ("codec", "batch"): "MINIO_CODEC_BATCH",
+    ("codec", "batch_deadline_ms"): "MINIO_CODEC_BATCH_DEADLINE_MS",
     ("logger", "level"): "MINIO_TPU_LOG_LEVEL",
 }
 
@@ -221,16 +221,26 @@ class ConfigSys:
     ) -> None:
         if subsys not in _DEFAULTS:
             raise ConfigError(f"unknown subsystem {subsys!r}")
+        import math
+
         for k, v in kvs.items():
             if k not in _DEFAULTS[subsys]:
                 raise ConfigError(f"unknown key {subsys}.{k}")
-            if (subsys, k) in _NUMERIC_KEYS:
+            spec = _NUMERIC_KEYS.get((subsys, k))
+            if spec is not None:
+                parser, floor = spec
                 try:
-                    float(v)
+                    num = parser(v)
                 except (TypeError, ValueError):
                     raise ConfigError(
-                        f"{subsys}.{k} must be numeric, got {v!r}"
+                        f"{subsys}.{k} must be {parser.__name__}, "
+                        f"got {v!r}"
                     ) from None
+                if not math.isfinite(num) or num < floor:
+                    raise ConfigError(
+                        f"{subsys}.{k} must be a finite number "
+                        f">= {floor}"
+                    )
         with self._mu:
             self._kv.setdefault(subsys, {}).setdefault(target, {}).update(
                 {k: str(v) for k, v in kvs.items()}
@@ -265,11 +275,15 @@ class ConfigSys:
                 for s, targets in self._kv.items()
                 for k in targets.get(DEFAULT_TARGET, {})
             }
+        codec_touched = False
+        logger_touched = False
         for (subsys, key), env in _ENV_SEAMS.items():
             if (subsys, key) in edited:
                 if env not in self._orig_env:
                     self._orig_env[env] = os.environ.get(env)
                 os.environ[env] = self.get(subsys, key)
+                codec_touched = codec_touched or subsys == "codec"
+                logger_touched = logger_touched or subsys == "logger"
             elif env in self._orig_env:
                 # edit was deleted: restore the operator's value
                 orig = self._orig_env.pop(env)
@@ -277,3 +291,19 @@ class ConfigSys:
                     os.environ.pop(env, None)
                 else:
                     os.environ[env] = orig
+                codec_touched = codec_touched or subsys == "codec"
+                logger_touched = logger_touched or subsys == "logger"
+        if codec_touched:
+            # the backend singleton captured the previous env; drop it
+            # so the next codec call rebuilds with the new settings
+            from ..codec import backend as backend_mod
+
+            backend_mod.reset_backend()
+        if logger_touched:
+            # log level is applied at setup time, not read per call
+            from ..utils import log
+
+            try:
+                log.setup(self.get("logger", "level"))
+            except Exception:  # noqa: BLE001
+                pass

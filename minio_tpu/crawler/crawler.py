@@ -394,12 +394,18 @@ class DataCrawler:
 
     def _effective_interval(self) -> float:
         try:
-            return float(
+            v = float(
                 os.environ.get("MINIO_TPU_CRAWL_INTERVAL_S")
                 or self._interval
             )
         except ValueError:
             return self._interval
+        # floor of 1s: wait(0) would busy-loop full-cluster crawls
+        import math
+
+        if not math.isfinite(v) or v < 1.0:
+            return max(self._interval, 1.0)
+        return v
 
     def _run(self) -> None:
         # initial delay so boot IO settles (crawler waits a cycle)

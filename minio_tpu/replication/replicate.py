@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import hashlib
 import http.client
-import io
 import queue
 import threading
 import urllib.parse
@@ -47,11 +46,10 @@ class LocalTarget:
         self._ol = object_layer
         self.bucket = bucket
 
-    def put(self, key: str, data: bytes, metadata: dict) -> None:
+    def put(self, key: str, reader, size: int, metadata: dict) -> None:
         self._ol.get_bucket_info(self.bucket)  # must exist
         self._ol.put_object(
-            self.bucket, key, io.BytesIO(data), len(data),
-            _clean_meta(metadata),
+            self.bucket, key, reader, size, _clean_meta(metadata)
         )
 
 
@@ -78,7 +76,7 @@ class HTTPTarget:
         self.region = region
         self.timeout = timeout
 
-    def put(self, key: str, data: bytes, metadata: dict) -> None:
+    def put(self, key: str, reader, size: int, metadata: dict) -> None:
         import datetime
 
         from ..server import auth as authmod
@@ -87,16 +85,26 @@ class HTTPTarget:
         amz_date = datetime.datetime.now(
             datetime.timezone.utc
         ).strftime("%Y%m%dT%H%M%SZ")
-        phash = hashlib.sha256(data).hexdigest()
+        # hash pass over the (seekable) spool, then rewind to send -
+        # the object is never held in memory whole
+        h = hashlib.sha256()
+        while True:
+            chunk = reader.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+        phash = h.hexdigest()
+        reader.seek(0)
         headers = {
             "host": f"{self.host}:{self.port}",
             "x-amz-date": amz_date,
             "x-amz-content-sha256": phash,
+            "content-length": str(size),
         }
         for k, v in _clean_meta(metadata).items():
             if k.startswith("x-amz-meta-") or k == "content-type":
                 headers[k] = v
-        signed = sorted(headers)
+        signed = sorted(k for k in headers if k != "content-length")
         sig = authmod.sign_v4(
             "PUT", path, {}, headers, signed, phash,
             self.access_key, self.secret_key, amz_date, self.region,
@@ -119,10 +127,18 @@ class HTTPTarget:
                 self.host, self.port, timeout=self.timeout
             )
         try:
-            conn.request(
-                "PUT", urllib.parse.quote(path), body=data,
-                headers=headers,
+            conn.putrequest(
+                "PUT", urllib.parse.quote(path),
+                skip_host=True, skip_accept_encoding=True,
             )
+            for k, v in headers.items():
+                conn.putheader(k, v)
+            conn.endheaders()
+            while True:
+                chunk = reader.read(1 << 20)
+                if not chunk:
+                    break
+                conn.send(chunk)
             resp = conn.getresponse()
             resp.read()
             if resp.status not in (200, 204):
@@ -264,13 +280,19 @@ class ReplicationPool:
         if rule is None:
             return
         info = ol.get_object_info(bucket, key, version_id)
-        buf = io.BytesIO()
-        ol.get_object(bucket, key, buf, version_id=version_id)
         status = "COMPLETED"
         try:
-            self._target_for(bucket, rule).put(
-                key, buf.getvalue(), dict(info.user_defined)
-            )
+            # spool through memory up to 16 MiB, disk beyond - a
+            # multi-GB object must not live in worker RAM
+            import tempfile
+
+            with tempfile.SpooledTemporaryFile(max_size=16 << 20) as sp:
+                ol.get_object(bucket, key, sp, version_id=version_id)
+                size = sp.tell()
+                sp.seek(0)
+                self._target_for(bucket, rule).put(
+                    key, sp, size, dict(info.user_defined)
+                )
         except Exception:  # noqa: BLE001
             status = "FAILED"
         try:

@@ -96,7 +96,10 @@ class AdminAPI:
             raw = self.s3.bucket_meta.get(
                 bucket
             ).replication_targets_json
-            docs = json.loads(raw) if raw else []
+            try:
+                docs = json.loads(raw) if raw else []
+            except ValueError:
+                docs = []
             docs = [
                 d
                 for d in docs
@@ -117,7 +120,14 @@ class AdminAPI:
         if route == ("GET", "get-config"):
             return 200, _json(self.s3.config.dump())
         if route == ("GET", "config-help"):
-            return 200, _json(self.s3.config.help(_req(q, "subsys")))
+            from ..config import ConfigError
+
+            try:
+                return 200, _json(
+                    self.s3.config.help(_req(q, "subsys"))
+                )
+            except ConfigError as e:
+                raise S3Error("InvalidArgument", str(e)) from None
         if route == ("PUT", "set-config-kv"):
             from ..config import ConfigError
 

@@ -172,11 +172,53 @@ class S3Server:
         self._event_rules_loaded: "set[str]" = set()
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
+        self.tls = False
+        # admission control (handler-api.go:85 maxClients): bounded
+        # concurrent S3 requests; excess waits up to the deadline then
+        # gets 503.  0 = unlimited.
+        self._inflight = 0
+        self._adm_mu = threading.Lock()
+        self._adm_cv = threading.Condition(self._adm_mu)
         # internode planes (storage/lock/peer/bootstrap REST, the
         # registerDistErasureRouters analogue, routers.go:25-38):
         # prefix -> handler(method_tail, query, body, headers)
         #           returning (status, body, extra_headers)
         self.internode: "dict[str, object]" = {}
+
+    def _requests_max(self) -> int:
+        try:
+            return int(os.environ.get("MINIO_TPU_REQUESTS_MAX") or 0)
+        except ValueError:
+            return 0
+
+    def _requests_deadline(self) -> float:
+        try:
+            return float(
+                os.environ.get("MINIO_TPU_REQUESTS_DEADLINE_S") or 10.0
+            )
+        except ValueError:
+            return 10.0
+
+    def admit(self) -> bool:
+        """Take an admission slot (True) or time out (False -> 503)."""
+        limit = self._requests_max()
+        with self._adm_cv:
+            if limit <= 0:
+                self._inflight += 1
+                return True
+            deadline = _time.monotonic() + self._requests_deadline()
+            while self._inflight >= limit:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._adm_cv.wait(remaining)
+            self._inflight += 1
+            return True
+
+    def release(self) -> None:
+        with self._adm_cv:
+            self._inflight = max(0, self._inflight - 1)
+            self._adm_cv.notify()
 
     def attach_iam(self, iam: IAMSys) -> None:
         """Swap in a store-backed IAMSys once the object layer is up
@@ -252,6 +294,8 @@ class S3Server:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> "S3Server":
+        from ..utils import tlsconf
+
         server = self
 
         class Handler(_Handler):
@@ -260,6 +304,13 @@ class S3Server:
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), Handler
         )
+        self.tls = tlsconf.enabled()
+        if self.tls:
+            # TLS listener (the reference's xhttp server takes the
+            # same certs for S3 and internode traffic)
+            self._httpd.socket = tlsconf.server_context().wrap_socket(
+                self._httpd.socket, server_side=True
+            )
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="s3-server", daemon=True
@@ -267,9 +318,16 @@ class S3Server:
         self._thread.start()
         return self
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain_s: float = 10.0) -> None:
+        """Stop accepting, then drain in-flight requests up to
+        ``drain_s`` (the reference's graceful shutdown,
+        cmd/http/server.go:116 request draining)."""
         if self._httpd:
-            self._httpd.shutdown()
+            self._httpd.shutdown()  # stop accepting new connections
+        deadline = _time.monotonic() + drain_s
+        while self._inflight > 0 and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        if self._httpd:
             self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
@@ -277,7 +335,8 @@ class S3Server:
 
     @property
     def endpoint(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if getattr(self, "tls", False) else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -507,10 +566,17 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
                 content_type="text/plain; version=0.0.4",
             )
+        # admission control (maxClients, handler-api.go:85): overload
+        # answers 503 instead of spawning unbounded work
+        if not self.s3.admit():
+            self.close_connection = True
+            self._error(s3errors.get("SlowDown"), path)
+            return
         t0 = _time.monotonic()
         try:
             self._route_authed(path, query)
         finally:
+            self.s3.release()
             # collectAPIStats analogue: every authed-path request lands
             # in the metrics registry
             try:

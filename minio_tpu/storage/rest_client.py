@@ -62,12 +62,12 @@ class RemoteShardWriter(ShardWriter):
         self._thread.start()
 
     def _run(self) -> None:
-        import http.client as hc
+        from ..utils import tlsconf
 
         conn = None
         try:
-            conn = hc.HTTPConnection(
-                self._c.host, self._c.port, timeout=self._c._timeout
+            conn = tlsconf.client_connection(
+                self._c.host, self._c.port, self._c._timeout
             )
             conn.putrequest("POST", self._url)
             conn.putheader("Authorization", f"Bearer {self._c._bearer()}")
@@ -204,8 +204,10 @@ class StorageRESTClient(StorageAPI):
     def _conn(self) -> http.client.HTTPConnection:
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = http.client.HTTPConnection(
-                self.host, self.port, timeout=self._timeout
+            from ..utils import tlsconf
+
+            c = tlsconf.client_connection(
+                self.host, self.port, self._timeout
             )
             self._local.conn = c
         return c

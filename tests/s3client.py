@@ -58,10 +58,25 @@ class S3Client:
     ):
         parsed = urllib.parse.urlsplit(endpoint)
         self.host = parsed.hostname
-        self.port = parsed.port or 80
+        self.tls = parsed.scheme == "https"
+        self.port = parsed.port or (443 if self.tls else 80)
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
+
+    def _connect(self):
+        if self.tls:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=30, context=ctx
+            )
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=30
+        )
 
     def request(
         self,
@@ -96,7 +111,7 @@ class S3Client:
             )
         qs = urllib.parse.urlencode(query)
         url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn = self._connect()
         try:
             conn.request(method, url, body=body or None, headers=headers)
             resp = conn.getresponse()
@@ -221,7 +236,7 @@ class S3Client:
             cksum = b64.b64encode(crc).decode()
             body += f"x-amz-checksum-crc32:{cksum}\r\n".encode()
         body += b"\r\n"
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn = self._connect()
         try:
             conn.request("PUT", path, body=bytes(body), headers=headers)
             resp = conn.getresponse()
@@ -257,7 +272,7 @@ class S3Client:
         headers["authorization"] = f"AWS {self.access_key}:{sig}"
         qs = urllib.parse.urlencode(query)
         url = urllib.parse.quote(path) + (f"?{qs}" if qs else "")
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn = self._connect()
         try:
             conn.request(method, url, body=body or None, headers=headers)
             resp = conn.getresponse()
@@ -339,7 +354,7 @@ class S3Client:
             "host": f"{self.host}:{self.port}",
             "content-type": f"multipart/form-data; boundary={boundary}",
         }
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        conn = self._connect()
         try:
             conn.request(
                 "POST", f"/{bucket}", body=bytes(body), headers=headers
