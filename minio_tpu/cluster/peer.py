@@ -165,6 +165,26 @@ class PeerRESTServer:
             return {"locks": []}
         return {"locks": self.local_locker.dump()}
 
+    def _trace_buf(self, q, body) -> dict:
+        """Drain this node's trace ring past `since` (the Trace peer
+        RPC, peer-rest-client.go:774, poll-based)."""
+        since = int(_q1(q, "since") or 0)
+        seq, items = self.s3.tracer.poll(since)
+        return {"seq": seq, "items": items}
+
+    def _console_buf(self, q, body) -> dict:
+        since = int(_q1(q, "since") or 0)
+        seq, items = self.s3.console.ring.since(since)
+        return {"seq": seq, "items": items}
+
+    def _start_profiling(self, q, body) -> dict:
+        self.s3.profiler.start(_q1(q, "type") or "cpu")
+        return {"ok": True}
+
+    def _download_profiling(self, q, body) -> dict:
+        data = self.s3.profiler.stop(_q1(q, "type") or "cpu")
+        return {"profile": data}
+
     def _verify_config(self, q, body) -> dict:
         """Bootstrap handshake: peer sends ITS fingerprint; we diff
         against ours field by field (bootstrap-peer-server.go:78-107)."""
@@ -186,6 +206,10 @@ class PeerRESTServer:
         "loadiam": _load_iam,
         "loadconfig": _load_config,
         "getlocks": _get_locks,
+        "tracebuf": _trace_buf,
+        "consolebuf": _console_buf,
+        "startprofiling": _start_profiling,
+        "downloadprofiling": _download_profiling,
         "verifyconfig": _verify_config,
     }
 

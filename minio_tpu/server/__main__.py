@@ -67,6 +67,25 @@ def build_cluster(
     from ..storage.xl import XLStorage
     from ..utils import ellipses
 
+    # standalone FS mode: exactly one local drive and no cluster
+    # topology (newObjectLayer FS selection, server-main.go:561-564).
+    # A drive already carrying an erasure format must never be
+    # reinterpreted as FS (that would misread xl-layout data).
+    flat = [a for g in group_zone_args(zone_args) for a in g]
+    if len(flat) == 1 and "://" not in flat[0]:
+        import os as _os
+
+        if _os.path.exists(
+            _os.path.join(flat[0], ".sys", "format.json")
+        ):
+            raise SystemExit(
+                f"{flat[0]} holds an erasure format; a single-drive FS "
+                "server cannot serve it (add the original drives)"
+            )
+        from ..objectlayer.fs import FSObjects
+
+        return FSObjects(flat[0]), []
+
     zones = []
     local_disks: list = []
     for specs in group_zone_args(zone_args):
@@ -324,7 +343,14 @@ def main(argv=None) -> int:
         local_disk_map=local_map,
         nslock=nslock,
     )
-    srv.object_layer = ol
+    # optional SSD read cache in front of the object layer
+    # (disk-cache.go CacheObjectLayer, server-main.go:531-540)
+    from ..objectlayer.cache import cache_from_env
+
+    ol_front = cache_from_env(ol)
+    if ol_front is not ol:
+        print("disk cache enabled")
+    srv.object_layer = ol_front
     # once formats are known, the storage REST plane serves the
     # DiskIDCheck-wrapped disks too: peer I/O must not write onto a
     # swapped drive either (xl-storage-disk-id-check.go applies to the
@@ -332,7 +358,7 @@ def main(argv=None) -> int:
     from ..storage.diskcheck import DiskIDCheck as _DIC
 
     guarded_map = {}
-    for zone in ol.zones:
+    for zone in getattr(ol, "zones", []):
         for eset in zone.sets:
             for d in eset.disks:
                 if isinstance(d, _DIC):
@@ -350,9 +376,10 @@ def main(argv=None) -> int:
         iam.start_refresher(
             float(os.environ.get("MINIO_TPU_IAM_REFRESH_S") or 120.0)
         )
-    _heal_routine, _disk_monitor = start_background_heal(ol)
-    srv.heal_routine = _heal_routine
-    srv.heal_queue = _heal_routine.queue
+    if getattr(ol, "zones", None):
+        _heal_routine, _disk_monitor = start_background_heal(ol)
+        srv.heal_routine = _heal_routine
+        srv.heal_queue = _heal_routine.queue
     # data crawler: usage accounting + lifecycle enforcement
     # (runDataCrawler, server-main.go:524 startBackgroundOps)
     from ..crawler import DataCrawler
@@ -368,18 +395,20 @@ def main(argv=None) -> int:
         replication=srv.replication,
     ).start()
     si = ol.storage_info()
-    print(
-        f"minio-tpu serving {len(ol.zones)} zone(s) "
-        f"{[z['disks'] for z in si['zones']]} drives at {srv.endpoint}"
-    )
+    if "zones" in si:
+        desc = (
+            f"{len(ol.zones)} zone(s) "
+            f"{[z['disks'] for z in si['zones']]} drives"
+        )
+        zcount = len(ol.zones)
+    else:
+        desc = "standalone FS backend (1 drive)"
+        zcount = 0
+    print(f"minio-tpu serving {desc} at {srv.endpoint}")
     sys.stdout.flush()
     log.logger("server").info(
         "online",
-        extra=log.kv(
-            endpoint=srv.endpoint,
-            zones=len(ol.zones),
-            drives=[z["disks"] for z in si["zones"]],
-        ),
+        extra=log.kv(endpoint=srv.endpoint, zones=zcount),
     )
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}, shutting down")

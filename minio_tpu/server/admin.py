@@ -44,6 +44,64 @@ class AdminAPI:
             return 200, self._heal(ol, q)
         if route == ("GET", "top-locks"):
             return 200, self._top_locks()
+        if route == ("GET", "cache-stats"):
+            stats_fn = getattr(ol, "cache_stats", None)
+            if stats_fn is None:
+                return 200, _json({"enabled": False})
+            return 200, _json({"enabled": True, **stats_fn()})
+        # profiling (admin-router.go:82): start on every node, download
+        # collects per-node artifacts in one JSON document
+        if route == ("POST", "profiling/start"):
+            kind = q.get("type", "cpu")
+            try:
+                self.s3.profiler.start(kind)
+            except (ValueError, RuntimeError) as e:
+                raise S3Error("InvalidArgument", str(e)) from None
+            peers = getattr(self.s3, "peer_notifier", None)
+            started = [self.s3.tracer.node]
+            if peers is not None:
+                for c in peers.clients:
+                    try:
+                        c.call("startprofiling", {"type": kind})
+                        started.append(f"{c.host}:{c.port}")
+                    except Exception:  # noqa: BLE001
+                        pass
+            return 200, _json({"started": started, "type": kind})
+        if route == ("GET", "profiling/download"):
+            import base64
+
+            kind = q.get("type", "cpu")
+            profiles: dict = {}
+            local_err = ""
+            try:
+                profiles[self.s3.tracer.node] = base64.b64encode(
+                    self.s3.profiler.stop(kind)
+                ).decode()
+            except RuntimeError as e:
+                # still stop the PEERS: bailing here would leave
+                # cProfile running on every other node forever
+                local_err = str(e)
+            peers = getattr(self.s3, "peer_notifier", None)
+            if peers is not None:
+                for c in peers.clients:
+                    try:
+                        res = c.call("downloadprofiling", {"type": kind})
+                        profiles[f"{c.host}:{c.port}"] = (
+                            base64.b64encode(
+                                res.get("profile", b"")
+                            ).decode()
+                        )
+                    except Exception:  # noqa: BLE001
+                        profiles[f"{c.host}:{c.port}"] = ""
+            if local_err and not any(profiles.values()):
+                raise S3Error("InvalidArgument", local_err)
+            return 200, _json(
+                {
+                    "type": kind,
+                    "profiles": profiles,
+                    **({"local_error": local_err} if local_err else {}),
+                }
+            )
         if route == ("GET", "datausage"):
             crawler = getattr(self.s3, "crawler", None)
             if crawler is None:

@@ -170,6 +170,14 @@ class S3Server:
 
         self.events = EventNotifier(targets_from_env()).start()
         self._event_rules_loaded: "set[str]" = set()
+        # tracing / audit / profiling / console capture (SURVEY §5)
+        from ..utils.profiling import Profiler
+        from .trace import AuditLog, ConsoleCapture, Tracer
+
+        self.tracer = Tracer(node=address)
+        self.audit = AuditLog()
+        self.profiler = Profiler()
+        self.console = ConsoleCapture(node=address).install()
         self._httpd: "ThreadingHTTPServer | None" = None
         self._thread: "threading.Thread | None" = None
         self.tls = False
@@ -583,12 +591,57 @@ class _Handler(BaseHTTPRequestHandler):
                 cl = int(self.headers.get("Content-Length") or 0)
             except ValueError:
                 cl = 0
+            dur = _time.monotonic() - t0
             self.s3.metrics.observe(
                 self._action or "Unknown",
                 self._last_status or 0,
-                _time.monotonic() - t0,
+                dur,
                 bytes_in=cl,
                 bytes_out=self._resp_bytes,
+            )
+            self._emit_trace_audit(path, query, dur, cl)
+
+    def _emit_trace_audit(self, path, query, dur, bytes_in) -> None:
+        """httpTrace + logger.AuditLog tail of every request."""
+        from . import trace as tracemod
+
+        client = self.client_address[0] if self.client_address else ""
+        if self.s3.tracer.active:
+            self.s3.tracer.publish(
+                tracemod.trace_info(
+                    self.s3.tracer.node,
+                    self.command,
+                    path,
+                    "&".join(f"{k}={v[0]}" for k, v in query.items()),
+                    self._last_status or 0,
+                    dur,
+                    bytes_in,
+                    self._resp_bytes,
+                    client,
+                    self._action or "Unknown",
+                )
+            )
+        if self.s3.audit.enabled:
+            parts = path.lstrip("/").split("/", 1)
+            self.s3.audit.log(
+                {
+                    "api": {
+                        "name": self._action or "Unknown",
+                        "bucket": parts[0],
+                        "object": parts[1] if len(parts) > 1 else "",
+                        "statusCode": self._last_status or 0,
+                        "timeToResponse_ms": round(dur * 1e3, 3),
+                    },
+                    "remotehost": client,
+                    "userAgent": self.headers.get("User-Agent", ""),
+                    "accessKey": (
+                        self._auth.access_key
+                        if self._auth and not self._auth.anonymous
+                        else ""
+                    ),
+                    "rx": bytes_in,
+                    "tx": self._resp_bytes,
+                }
             )
 
     def _route_authed(self, path: str, query) -> None:
@@ -669,6 +722,9 @@ class _Handler(BaseHTTPRequestHandler):
         if ctx.anonymous or not self.s3.iam.is_owner(ctx.access_key):
             raise S3Error("AccessDenied", "admin requires the owner")
         self._action = f"Admin.{tail}"
+        if tail in ("trace", "console"):
+            self._finish_body()
+            return self._admin_stream(tail, query)
         body = b""
         if self.command in ("PUT", "POST"):
             body = self._read_body()
@@ -684,6 +740,73 @@ class _Handler(BaseHTTPRequestHandler):
             raise mapped from e
         self._finish_body()
         self._respond(status, payload, content_type="application/json")
+
+    def _admin_stream(self, kind: str, query) -> None:
+        """`mc admin trace` / `mc admin console`: stream JSON lines for
+        ``duration`` seconds, merging this node's ring with every
+        peer's (TraceHandler + peerRESTClient.Trace aggregation,
+        cmd/admin-handlers.go:1007)."""
+        import json as _json
+
+        try:
+            duration = float(query.get("duration", ["10"])[0])
+        except ValueError:
+            duration = 10.0
+        duration = max(0.1, min(duration, 300.0))
+        local_ring = (
+            self.s3.tracer.ring
+            if kind == "trace"
+            else self.s3.console.ring
+        )
+        peers = (
+            self.s3.peer_notifier.clients
+            if self.s3.peer_notifier is not None
+            else []
+        )
+        self.send_response(200)
+        self.send_header("Server", "MinIO-TPU")
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Connection", "close")
+        self.close_connection = True
+        self.end_headers()
+        # poll positions: ours + one per peer
+        local_seq, _ = self.s3.tracer.poll(1 << 62) if kind == "trace" \
+            else local_ring.since(1 << 62)
+        peer_seq = {id(p): 0 for p in peers}
+        # peers start from NOW, not their whole ring history
+        for p in peers:
+            try:
+                res = p.call(f"{kind}buf", {"since": str(1 << 62)})
+                peer_seq[id(p)] = res.get("seq", 0)
+            except Exception:  # noqa: BLE001
+                pass
+        deadline = _time.monotonic() + duration
+        while _time.monotonic() < deadline:
+            batch: list = []
+            if kind == "trace":
+                local_seq, items = self.s3.tracer.poll(local_seq)
+            else:
+                local_seq, items = local_ring.since(local_seq)
+            batch.extend(items)
+            for p in peers:
+                try:
+                    res = p.call(
+                        f"{kind}buf", {"since": str(peer_seq[id(p)])}
+                    )
+                    peer_seq[id(p)] = res.get("seq", peer_seq[id(p)])
+                    batch.extend(res.get("items", []))
+                except Exception:  # noqa: BLE001
+                    pass
+            batch.sort(key=lambda e: e.get("time", 0))
+            try:
+                for item in batch:
+                    line = (_json.dumps(item) + "\n").encode()
+                    self.wfile.write(line)
+                    self._resp_bytes += len(line)
+                self.wfile.flush()
+            except OSError:
+                return  # client went away
+            _time.sleep(0.5)
 
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = route
 

@@ -161,6 +161,9 @@ def from_exception(e: Exception) -> APIError:
         return e.err
     if isinstance(e, AuthError):
         return get(e.code, str(e) if str(e) else "")
+    if isinstance(e, NotImplementedError):
+        # backend without the capability (FS versioning, gateways)
+        return get("NotImplemented", str(e) or "")
     mapping = [
         (olapi.BucketNotFound, "NoSuchBucket"),
         (olapi.BucketExists, "BucketAlreadyOwnedByYou"),

@@ -1,0 +1,161 @@
+"""HTTP tracing + audit logging + console-log capture
+(cmd/http-tracer.go:99 Trace, cmd/logger/audit.go:129 AuditLog,
+cmd/consolelogger.go).
+
+Every S3/admin request produces a TraceInfo published to the node's
+trace PubSub AND appended to a sequence-numbered ring buffer - the
+ring is what peers poll (`tracebuf?since=N`) so `admin trace` streams
+cluster-wide without holding a connection per peer.  The audit log is
+an independent JSON-lines sink (file via MINIO_TPU_AUDIT_LOG_FILE).
+Console capture attaches a logging.Handler feeding the same ring
+mechanism for `admin console`.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+from ..utils.pubsub import PubSub
+
+RING_MAX = 4096
+
+
+class SeqRing:
+    """Sequence-numbered ring buffer; readers poll with `since`."""
+
+    def __init__(self, maxlen: int = RING_MAX):
+        self._mu = threading.Lock()
+        self._buf: collections.deque = collections.deque(maxlen=maxlen)
+        self._seq = 0
+
+    def append(self, item: dict) -> int:
+        with self._mu:
+            self._seq += 1
+            self._buf.append((self._seq, item))
+            return self._seq
+
+    def since(self, seq: int, limit: int = 1000) -> "tuple[int, list]":
+        """Entries with sequence > seq -> (latest_seq, items)."""
+        with self._mu:
+            items = [
+                it for s, it in self._buf if s > seq
+            ][:limit]
+            return self._seq, items
+
+
+class Tracer:
+    """Per-node trace hub: pubsub for local subscribers + the ring
+    peers poll."""
+
+    def __init__(self, node: str = ""):
+        self.node = node
+        self.pubsub = PubSub()
+        self.ring = SeqRing()
+        # count ring polls as interest so traced nodes keep recording
+        self._last_poll = 0.0
+
+    @property
+    def active(self) -> bool:
+        return (
+            self.pubsub.num_subscribers > 0
+            or time.monotonic() - self._last_poll < 10.0
+        )
+
+    def publish(self, info: dict) -> None:
+        info.setdefault("node", self.node)
+        self.pubsub.publish(info)
+        self.ring.append(info)
+
+    def poll(self, since: int) -> "tuple[int, list]":
+        self._last_poll = time.monotonic()
+        return self.ring.since(since)
+
+
+def trace_info(
+    node: str,
+    method: str,
+    path: str,
+    query: str,
+    status: int,
+    duration_s: float,
+    bytes_in: int,
+    bytes_out: int,
+    client: str,
+    api: str,
+) -> dict:
+    """The pkg/trace.Info DTO shape, trimmed to JSON-friendly fields."""
+    return {
+        "node": node,
+        "time": time.time(),
+        "api": api,
+        "method": method,
+        "path": path,
+        "query": query,
+        "status": status,
+        "duration_ms": round(duration_s * 1e3, 3),
+        "rx": bytes_in,
+        "tx": bytes_out,
+        "client": client,
+    }
+
+
+class AuditLog:
+    """Per-request audit entries as JSON lines
+    (logger.AuditLog, cmd/logger/audit.go:129)."""
+
+    def __init__(self, path: "str | None" = None):
+        self.path = path or os.environ.get(
+            "MINIO_TPU_AUDIT_LOG_FILE", ""
+        )
+        self._mu = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path)
+
+    def log(self, entry: dict) -> None:
+        if not self.path:
+            return
+        entry.setdefault("version", "1")
+        entry.setdefault("time", time.time())
+        line = json.dumps(entry) + "\n"
+        try:
+            with self._mu, open(self.path, "a", encoding="utf-8") as f:
+                f.write(line)
+        except OSError:
+            pass
+
+
+class ConsoleCapture(logging.Handler):
+    """Ring-buffered capture of this process's structured logs
+    (cmd/consolelogger.go HTTPConsoleLoggerSys)."""
+
+    def __init__(self, node: str = ""):
+        super().__init__()
+        self.node = node
+        self.ring = SeqRing()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.ring.append(
+                {
+                    "node": self.node,
+                    "time": record.created,
+                    "level": record.levelname,
+                    "name": record.name,
+                    "msg": record.getMessage(),
+                }
+            )
+        except Exception:  # noqa: BLE001 - logging must never raise
+            pass
+
+    def install(self) -> "ConsoleCapture":
+        # the framework logger stops propagation once log.setup runs,
+        # so capture must attach at "minio_tpu", not the root
+        logging.getLogger("minio_tpu").addHandler(self)
+        return self

@@ -1,0 +1,168 @@
+"""Tracing, audit log, console capture, profiling
+(cmd/http-tracer.go, cmd/logger/audit.go, admin profiling routes,
+peer tracebuf aggregation)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.server.trace import SeqRing
+from minio_tpu.storage.xl import XLStorage
+from minio_tpu.utils.pubsub import PubSub
+
+from s3client import S3Client
+
+
+@pytest.fixture()
+def server(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    yield srv
+    srv.shutdown()
+
+
+def test_pubsub_basics():
+    ps = PubSub()
+    with ps.subscribe() as sub:
+        ps.publish({"a": 1})
+        assert sub.get(timeout=1) == {"a": 1}
+        assert ps.num_subscribers == 1
+    assert ps.num_subscribers == 0
+
+
+def test_seqring_since():
+    r = SeqRing(maxlen=4)
+    for i in range(6):
+        r.append({"n": i})
+    seq, items = r.since(0)
+    assert seq == 6
+    assert [i["n"] for i in items] == [2, 3, 4, 5]  # oldest evicted
+    seq2, items2 = r.since(seq)
+    assert items2 == []
+
+
+def test_trace_records_requests(server):
+    c = S3Client(server.endpoint)
+    # no subscribers: requests do not trace
+    c.make_bucket("trbkt")
+    time.sleep(0.2)  # the trace tail runs after the response is sent
+    seq, items = server.tracer.ring.since(0)
+    assert items == []
+    # polling marks interest; subsequent requests land in the ring
+    server.tracer.poll(0)
+    c.put_object("trbkt", "k", b"x")
+    c.get_object("trbkt", "k")
+    time.sleep(0.2)
+    seq, items = server.tracer.poll(0)
+    apis = [i["api"] for i in items]
+    assert "PutObject" in apis and "GetObject" in apis
+    put = next(i for i in items if i["api"] == "PutObject")
+    assert put["method"] == "PUT" and put["status"] == 200
+    assert put["duration_ms"] > 0
+
+
+def test_admin_trace_stream(server):
+    c = S3Client(server.endpoint)
+    c.make_bucket("stream")
+    import threading
+
+    results = {}
+
+    def watch():
+        results["resp"] = c.request(
+            "GET", "/minio-tpu/admin/v1/trace",
+            query={"duration": "2"},
+        )
+
+    t = threading.Thread(target=watch)
+    t.start()
+    time.sleep(0.7)  # stream is up and polling
+    c.put_object("stream", "traced-object", b"payload")
+    t.join(timeout=10)
+    body = results["resp"].body.decode()
+    lines = [json.loads(x) for x in body.splitlines() if x]
+    assert any(
+        e.get("api") == "PutObject" and "traced-object" in e.get("path", "")
+        for e in lines
+    )
+
+
+def test_audit_log_written(tmp_path, server):
+    path = str(tmp_path / "audit.jsonl")
+    server.audit.path = path
+    c = S3Client(server.endpoint)
+    c.make_bucket("auditbkt")
+    c.put_object("auditbkt", "k", b"x")
+    time.sleep(0.3)  # the audit tail runs after the response is sent
+    with open(path, encoding="utf-8") as f:
+        entries = [json.loads(x) for x in f.read().splitlines()]
+    put = next(
+        e for e in entries if e["api"]["name"] == "PutObject"
+    )
+    assert put["api"]["bucket"] == "auditbkt"
+    assert put["api"]["statusCode"] == 200
+    assert put["accessKey"] == "minioadmin"
+
+
+def test_console_capture(server):
+    from minio_tpu.utils import log
+
+    log.logger("test-console").error("console-captured-line")
+    seq, items = server.console.ring.since(0)
+    assert any("console-captured-line" in i["msg"] for i in items)
+
+
+def test_profiling_roundtrip(server):
+    c = S3Client(server.endpoint)
+    r = c.request(
+        "POST", "/minio-tpu/admin/v1/profiling/start",
+        query={"type": "cpu"}, body=b"",
+    )
+    assert r.status == 200, r.body
+    c.make_bucket("profbkt")  # some work to profile
+    r = c.request(
+        "GET", "/minio-tpu/admin/v1/profiling/download",
+        query={"type": "cpu"},
+    )
+    assert r.status == 200
+    import base64
+
+    doc = json.loads(r.body)
+    prof = base64.b64decode(doc["profiles"][server.tracer.node])
+    assert b"cumulative" in prof  # pstats output
+    # double-download errors cleanly
+    r = c.request(
+        "GET", "/minio-tpu/admin/v1/profiling/download",
+        query={"type": "cpu"},
+    )
+    assert r.status == 400
+
+
+def test_peer_trace_buf(server):
+    """The tracebuf peer RPC serves the ring with sequence cursors."""
+    from minio_tpu.cluster import peer as peer_mod
+    from minio_tpu.utils import jwt
+
+    psrv = peer_mod.PeerRESTServer(server, "sek")
+    server.tracer.poll(0)  # mark active
+    c = S3Client(server.endpoint)
+    c.make_bucket("peertrace")
+    time.sleep(0.3)  # the trace tail runs after the response is sent
+    token = jwt.sign({"sub": "p"}, "sek", 60)
+    status, payload, _ = psrv.handle(
+        "tracebuf", {"since": "0"}, b"",
+        {"Authorization": f"Bearer {token}"},
+    )
+    assert status == 200
+    import msgpack
+
+    doc = msgpack.unpackb(payload, raw=False)
+    assert doc["seq"] >= 1
+    assert any(i["api"] == "MakeBucket" for i in doc["items"]) or any(
+        i["api"] == "CreateBucket" for i in doc["items"]
+    )
