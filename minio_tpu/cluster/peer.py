@@ -185,6 +185,19 @@ class PeerRESTServer:
         data = self.s3.profiler.stop(_q1(q, "type") or "cpu")
         return {"profile": data}
 
+    def _cycle_bloom(self, q, body) -> dict:
+        """Rotate this node's data-update tracker and return its
+        filter for [oldest, current) (the CycleServerBloomFilter peer
+        RPC, peer-rest-client.go cycleServerBloomFilter)."""
+        tracker = getattr(self.s3, "update_tracker", None)
+        if tracker is None:
+            return {"ok": False}
+        req = _unpack(body) or {}
+        resp = tracker.cycle_filter(
+            int(req.get("oldest", 0)), int(req.get("current", 0))
+        )
+        return {"ok": True, **resp.to_wire()}
+
     def _verify_config(self, q, body) -> dict:
         """Bootstrap handshake: peer sends ITS fingerprint; we diff
         against ours field by field (bootstrap-peer-server.go:78-107)."""
@@ -210,6 +223,7 @@ class PeerRESTServer:
         "consolebuf": _console_buf,
         "startprofiling": _start_profiling,
         "downloadprofiling": _download_profiling,
+        "cyclebloom": _cycle_bloom,
         "verifyconfig": _verify_config,
     }
 
@@ -355,6 +369,14 @@ class PeerRESTClient:
     def get_locks(self) -> list:
         return self.call("getlocks").get("locks", [])
 
+    def cycle_bloom(self, oldest: int, current: int) -> "dict | None":
+        """Peer's data-update filter for [oldest, current); None when
+        the peer has no tracker."""
+        resp = self.call(
+            "cyclebloom", doc={"oldest": oldest, "current": current}
+        )
+        return resp if resp.get("ok") else None
+
     def verify_config(self, fingerprint: dict) -> dict:
         return self.call("verifyconfig", doc=fingerprint)
 
@@ -429,6 +451,14 @@ class PeerNotifier:
 
     def all_locks(self) -> "list[list]":
         return self._gather(lambda c: c.get_locks(), lambda c: [])
+
+    def cycle_blooms(self, oldest: int, current: int) -> "list[dict | None]":
+        """Every peer's update filter; None marks an unreachable or
+        trackerless peer (the caller must then treat the union as
+        incomplete)."""
+        return self._gather(
+            lambda c: c.cycle_bloom(oldest, current), lambda c: None
+        )
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

@@ -19,6 +19,16 @@ One daemon thread cycles over every bucket:
 The crawler paces itself (``sleep_every``/``sleep_s``) instead of
 scanning flat out - the dataCrawlSleepPerFolder throttle - so a big
 namespace does not monopolize the disks.
+
+When a :class:`~minio_tpu.crawler.updatetracker.DataUpdateTracker` is
+attached, each sweep first rotates the bloom filter
+(cycleFilter, data-update-tracker.go:533) and skips buckets whose
+cached usage exists and whose name never hit the filter.  Guards,
+matching the reference's behavior: a bucket with lifecycle, FIFO
+quota, or replication config is always swept (time alone changes what
+those do), a full sweep runs every ``_FULL_SWEEP_EVERY`` cycles
+(dataUsageUpdateDirCycles), and an incomplete filter (restart, peer
+down) disables skipping for that sweep.
 """
 
 from __future__ import annotations
@@ -34,6 +44,9 @@ from ..ilm import Action, Lifecycle, LifecycleError
 from ..objectlayer.api import META_BUCKET
 
 USAGE_PATH = "data-usage/usage.json"
+# even "clean" buckets get re-swept this often (bloom false negatives
+# are impossible, but cached usage can rot via out-of-band mutation)
+_FULL_SWEEP_EVERY = 16
 
 
 @dataclasses.dataclass
@@ -53,6 +66,8 @@ class DataUsage:
     """The cluster usage snapshot (madmin DataUsageInfo shape)."""
 
     last_update_ns: int = 0
+    # bloom cycle index of the sweep that produced this snapshot
+    cycles: int = 0
     buckets: "dict[str, BucketUsage]" = dataclasses.field(
         default_factory=dict
     )
@@ -68,6 +83,7 @@ class DataUsage:
     def to_dict(self) -> dict:
         return {
             "last_update_ns": self.last_update_ns,
+            "cycles": self.cycles,
             "objects_total": self.objects_total,
             "size_total": self.size_total,
             "buckets_count": len(self.buckets),
@@ -91,11 +107,25 @@ class DataCrawler:
         sleep_every: int = 256,
         sleep_s: float = 0.05,
         replication=None,
+        tracker=None,
+        cycle_bloom=None,
+        leader_lock=None,
     ):
         self._ol = object_layer
         self._meta = bucket_meta
         self._interval = interval_s
         self._events = events
+        # data-update tracker: local instance, or a callable
+        # (oldest, current) -> BloomResponse that unions the cluster's
+        # filters (distributed mode); cycle_bloom wins when both given
+        self._tracker = tracker
+        self._cycle_bloom = cycle_bloom
+        # distributed mode: a cluster-wide lock elects ONE sweeping
+        # node per cycle (the reference serializes runDataCrawler
+        # behind a leader lock for the same reason) - without it every
+        # node would rotate every peer's bloom tracker with its own
+        # unsynchronized counter and double-run lifecycle deletes
+        self._leader_lock = leader_lock
         # ReplicationPool for the healReplication catch-up pass
         self._replication = replication
         # server callback hydrating a bucket's notification rules
@@ -119,6 +149,7 @@ class DataCrawler:
             doc = json.loads(buf.getvalue())
             return DataUsage(
                 last_update_ns=doc.get("last_update_ns", 0),
+                cycles=doc.get("cycles", 0),
                 buckets={
                     name: BucketUsage(**u)
                     for name, u in doc.get("buckets", {}).items()
@@ -244,17 +275,78 @@ class DataCrawler:
         # background cycle must not interleave deletes or publish
         # out-of-order usage snapshots
         with self._crawl_mu:
-            return self._crawl_locked()
+            if self._leader_lock is None:
+                return self._crawl_locked()
+            from ..dsync.namespace import LockTimeout
+
+            try:
+                with self._leader_lock():
+                    return self._crawl_locked()
+            except LockTimeout:
+                # another node holds crawl leadership this cycle
+                return self.usage()
+
+    def _rotate_bloom(self, oldest: int, current: int):
+        """Cluster-union update filter for [oldest, current), or None
+        when no tracker is attached / the rotation failed."""
+        try:
+            if self._cycle_bloom is not None:
+                return self._cycle_bloom(oldest, current)
+            if self._tracker is not None:
+                return self._tracker.cycle_filter(oldest, current)
+        except Exception:  # noqa: BLE001 - a broken filter only
+            return None  # disables skipping, never the sweep
+        return None
+
+    def _bucket_needs_sweep(self, bucket: str) -> bool:
+        """Buckets where a sweep does WORK (lifecycle, FIFO quota,
+        replication catch-up) are never bloom-skipped: time passing
+        changes what those subsystems must do even with zero writes."""
+        if self._bucket_lifecycle(bucket) is not None:
+            return True
+        from ..objectlayer import quota as quotamod
+
+        qcfg = quotamod.config_for(self._meta, bucket)
+        if qcfg is not None and qcfg.quota_type == "fifo":
+            return True
+        repl = self._replication
+        return repl is not None and repl.config_for(bucket) is not None
 
     def _crawl_locked(self) -> DataUsage:
-        usage = DataUsage(last_update_ns=time.time_ns())
+        # re-read the persisted snapshot: in distributed mode crawl
+        # leadership floats between nodes and the cycle counter lives
+        # in the (shared) usage document, not in process memory - a
+        # node that was follower for N cycles must not rewind the
+        # cluster's bloom trackers with its stale cached counter
+        prev = self._load_usage()
+        if prev.last_update_ns == 0 and prev.cycles == 0:
+            prev = self.usage()  # store unreadable: trust memory
+        next_cycle = prev.cycles + 1
+        usage = DataUsage(
+            last_update_ns=time.time_ns(), cycles=next_cycle
+        )
         try:
             buckets = self._ol.list_buckets()
         except Exception:  # noqa: BLE001
-            return self.usage()
+            return prev
+        resp = self._rotate_bloom(prev.cycles, next_cycle)
+        skip_ok = (
+            resp is not None
+            and resp.complete
+            and next_cycle % _FULL_SWEEP_EVERY != 0
+        )
         for b in buckets:
             bucket = b.name
             if bucket.startswith("."):  # reserved meta volumes
+                continue
+            prior = prev.buckets.get(bucket)
+            if (
+                skip_ok
+                and prior is not None
+                and not resp.filter.contains_dir(bucket)
+                and not self._bucket_needs_sweep(bucket)
+            ):
+                usage.buckets[bucket] = prior  # clean: reuse as-is
                 continue
             usage.buckets[bucket] = self._crawl_bucket(bucket)
         with self._mu:

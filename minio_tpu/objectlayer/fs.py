@@ -25,6 +25,7 @@ import uuid
 
 from ..codec import compress as compmod
 from ..utils.hashreader import HashReader
+from ..crawler.updatetracker import object_path_updated
 from . import api
 from .api import (
     BucketExists,
@@ -191,6 +192,7 @@ class FSObjects(ObjectLayer):
             bucket, object_name,
             {"meta": meta, "size": stored, "actual": actual, "mod": mod},
         )
+        object_path_updated(f"{bucket}/{object_name}")
         return ObjectInfo(
             bucket=bucket,
             name=object_name,
@@ -277,6 +279,7 @@ class FSObjects(ObjectLayer):
                     meta[k] = v
             doc["meta"] = meta
             self._store_meta(bucket, object_name, doc)
+        object_path_updated(f"{bucket}/{object_name}")
         return self.get_object_info(bucket, object_name)
 
     def delete_object(
@@ -302,6 +305,7 @@ class FSObjects(ObjectLayer):
             except OSError:
                 break
             d = os.path.dirname(d)
+        object_path_updated(f"{bucket}/{object_name}")
         return ObjectInfo(bucket=bucket, name=object_name)
 
     def copy_object(
@@ -565,6 +569,7 @@ class FSObjects(ObjectLayer):
             {"meta": meta, "size": total, "actual": total, "mod": mod},
         )
         shutil.rmtree(d, ignore_errors=True)
+        object_path_updated(f"{bucket}/{object_name}")
         return ObjectInfo(
             bucket=bucket,
             name=object_name,

@@ -8,11 +8,23 @@ order, listings merge across zones.  Each zone is an ErasureSets.
 
 from __future__ import annotations
 
-import random
+import threading
+import time
+import zlib
 
 from . import api
 from .api import ListObjectsInfo, ObjectLayer
 from .sets import ErasureSets, merge_list_results
+from ..crawler.updatetracker import object_path_updated
+
+# Stop placing new objects in a zone once it is this full
+# (diskFillFraction, erasure-zones.go:37).
+_DISK_FILL_FRACTION = 0.95
+# Free-space snapshots are refreshed at most this often; placement
+# between refreshes reuses the cached distribution, so PUTs do not
+# stat every disk (the reference reads cached StorageUsageInfo from
+# the crawler rather than statting per call).
+_USAGE_TTL_S = 10.0
 
 
 class ErasureZones(ObjectLayer):
@@ -20,43 +32,92 @@ class ErasureZones(ObjectLayer):
         if not zones:
             raise ValueError("need at least one zone")
         self.zones = zones
+        self._usage_lock = threading.Lock()
+        self._usage_ts = 0.0
+        self._usage: "list[tuple[int, int]]" = []  # (free, total) per zone
+        self._usage_refreshing = False
 
     # -- placement --------------------------------------------------------
 
-    def _zone_free(self, zone: ErasureSets) -> int:
-        free = 0
+    def _zone_space(self, zone: ErasureSets) -> "tuple[int, int]":
+        free = total = 0
         for s in zone.sets:
             for d in s._online_disks():
                 if d is None:
                     continue
                 try:
-                    free += d.disk_info().free
+                    di = d.disk_info()
+                    free += di.free
+                    total += di.total
                 except Exception:  # noqa: BLE001
                     pass
-        return free
+        return free, total
 
-    def _put_zone_index(self, bucket: str, object_name: str) -> int:
+    def _usage_snapshot(self) -> "list[tuple[int, int]]":
+        """TTL-cached free/total per zone.  The disk statting runs
+        OUTSIDE the lock: when the TTL lapses one caller refreshes
+        while concurrent PUTs keep placing on the stale snapshot
+        instead of queueing behind a cluster-wide stat (a down remote
+        disk's timeout must not stall every placement)."""
+        now = time.monotonic()
+        with self._usage_lock:
+            fresh = self._usage and now - self._usage_ts <= _USAGE_TTL_S
+            if fresh or (self._usage_refreshing and self._usage):
+                return self._usage
+            self._usage_refreshing = True
+        try:
+            snap = [self._zone_space(z) for z in self.zones]
+        finally:
+            with self._usage_lock:
+                self._usage_refreshing = False
+        with self._usage_lock:
+            self._usage = snap
+            self._usage_ts = time.monotonic()
+        return snap
+
+    def _available_space(self, size: int) -> "list[int]":
+        """Post-write available bytes per zone; 0 when the write would
+        not fit or would push the zone past the fill fraction
+        (getZonesAvailableSpace, erasure-zones.go:135-181)."""
+        size = max(size, 0)
+        out = []
+        for free, total in self._usage_snapshot():
+            if free < size:
+                out.append(0)
+                continue
+            avail = free - size
+            want_left = int(total * (1.0 - _DISK_FILL_FRACTION))
+            out.append(0 if avail <= want_left else avail)
+        return out
+
+    def _put_zone_index(self, bucket: str, object_name: str,
+                        size: int = 0) -> int:
         """Zone for a new write: existing object stays in its zone
-        (erasure-zones.go getZoneIdx), else weighted by free space."""
+        (erasure-zones.go getZoneIdx); otherwise the key is hashed onto
+        the cumulative free-space distribution — proportional-to-free
+        like the reference's getAvailableZoneIdx but deterministic per
+        key, so placement is reproducible and testable."""
+        if len(self.zones) == 1:
+            return 0
         for i, z in enumerate(self.zones):
             try:
                 z.get_object_info(bucket, object_name)
                 return i
             except Exception:  # noqa: BLE001
                 continue
-        if len(self.zones) == 1:
-            return 0
-        frees = [self._zone_free(z) for z in self.zones]
-        total = sum(frees)
+        avail = self._available_space(size)
+        total = sum(avail)
         if total <= 0:
-            return 0
-        # deterministic-enough weighted choice (reference uses free
-        # threshold ratios, erasure-zones.go:113-184)
-        r = random.random() * total
+            # every zone past the fill threshold: fall back to rawest
+            # free space so writes degrade rather than fail
+            snap = self._usage_snapshot()
+            return max(range(len(snap)), key=lambda i: snap[i][0])
+        frac = zlib.crc32(f"{bucket}/{object_name}".encode()) / 2**32
+        choose = int(frac * total)
         acc = 0
-        for i, f in enumerate(frees):
-            acc += f
-            if r <= acc:
+        for i, a in enumerate(avail):
+            acc += a
+            if acc > choose and a > 0:
                 return i
         return len(self.zones) - 1
 
@@ -110,11 +171,13 @@ class ErasureZones(ObjectLayer):
     def put_object(self, bucket, object_name, reader, size=-1, metadata=None,
                    versioned=False, compress=None, sse=None):
         self.zones[0].get_bucket_info(bucket)  # bucket must exist
-        zi = self._put_zone_index(bucket, object_name)
-        return self.zones[zi].put_object(
+        zi = self._put_zone_index(bucket, object_name, max(size, 0))
+        info = self.zones[zi].put_object(
             bucket, object_name, reader, size, metadata, versioned,
             compress, sse,
         )
+        object_path_updated(f"{bucket}/{object_name}")
+        return info
 
     def get_object(self, bucket, object_name, writer, offset=0, length=-1,
                    version_id="", sse=None):
@@ -134,9 +197,11 @@ class ErasureZones(ObjectLayer):
                            version_id=""):
         self.zones[0].get_bucket_info(bucket)
         z = self._find_zone(bucket, object_name, version_id)
-        return z.update_object_meta(
+        out = z.update_object_meta(
             bucket, object_name, updates, version_id
         )
+        object_path_updated(f"{bucket}/{object_name}")
+        return out
 
     def _zone_with_versions(self, bucket, object_name):
         """First zone holding ANY journal entry for the key (incl.
@@ -159,9 +224,11 @@ class ErasureZones(ObjectLayer):
             z = self._zone_with_versions(bucket, object_name)
             if z is None:
                 z = self.zones[self._put_zone_index(bucket, object_name)]
-            return z.delete_object(
+            dinfo = z.delete_object(
                 bucket, object_name, "", versioned, version_suspended
             )
+            object_path_updated(f"{bucket}/{object_name}")
+            return dinfo
         try:
             z = self._find_zone(bucket, object_name, version_id)
         except (api.ObjectNotFound, api.VersionNotFound):
@@ -170,7 +237,9 @@ class ErasureZones(ObjectLayer):
             z = self._zone_with_versions(bucket, object_name)
             if z is None:
                 raise
-        return z.delete_object(bucket, object_name, version_id)
+        dinfo = z.delete_object(bucket, object_name, version_id)
+        object_path_updated(f"{bucket}/{object_name}")
+        return dinfo
 
     def copy_object(self, src_bucket, src_object, dst_bucket, dst_object,
                     metadata=None, versioned=False, sse_src=None,
@@ -181,10 +250,12 @@ class ErasureZones(ObjectLayer):
         if src_bucket == dst_bucket and src_object == dst_object:
             # self-copy: delegate down to the set, whose sequential
             # path avoids the namespace-lock deadlock
-            return src_zone.copy_object(
+            info = src_zone.copy_object(
                 src_bucket, src_object, dst_bucket, dst_object,
                 metadata, versioned, sse_src, sse,
             )
+            object_path_updated(f"{dst_bucket}/{dst_object}")
+            return info
         info = src_zone.get_object_info(src_bucket, src_object)
         meta = api.prepare_copy_meta(info, metadata)
         return streaming_copy(
@@ -289,9 +360,11 @@ class ErasureZones(ObjectLayer):
     def complete_multipart_upload(self, bucket, object_name, upload_id,
                                   parts, versioned=False):
         z, uid = self._upload_zone(upload_id)
-        return z.complete_multipart_upload(
+        info = z.complete_multipart_upload(
             bucket, object_name, uid, parts, versioned
         )
+        object_path_updated(f"{bucket}/{object_name}")
+        return info
 
     def storage_info(self) -> dict:
         return {"zones": [z.storage_info() for z in self.zones]}

@@ -380,9 +380,45 @@ def main(argv=None) -> int:
         _heal_routine, _disk_monitor = start_background_heal(ol)
         srv.heal_routine = _heal_routine
         srv.heal_queue = _heal_routine.queue
+    # data-update tracker: object mutations mark a persisted bloom
+    # journal the crawler uses to skip clean buckets
+    # (data-update-tracker.go:63)
+    from ..crawler import updatetracker as ut_mod
+
+    tracker_root = next(iter(guarded_map), None) or getattr(
+        ol, "root", None
+    )
+    tracker = ut_mod.DataUpdateTracker(
+        path=os.path.join(tracker_root, ".sys", "update-tracker.bin")
+        if tracker_root
+        else None
+    )
+    ut_mod.install_tracker(tracker)
+    srv.update_tracker = tracker
+    notifier = getattr(srv, "peer_notifier", None)
+
+    def _cluster_bloom(oldest: int, current: int):
+        """Union of this node's filter and every peer's; any
+        unreachable/trackerless peer poisons completeness so the
+        crawler falls back to a full sweep."""
+        resp = tracker.cycle_filter(oldest, current)
+        if notifier is not None:
+            for wire in notifier.cycle_blooms(oldest, current):
+                if wire is None:
+                    resp.complete = False
+                    continue
+                peer_resp = ut_mod.BloomResponse.from_wire(wire)
+                resp.complete = resp.complete and peer_resp.complete
+                try:
+                    resp.filter.union_into(peer_resp.filter)
+                except ValueError:
+                    resp.complete = False
+        return resp
+
     # data crawler: usage accounting + lifecycle enforcement
     # (runDataCrawler, server-main.go:524 startBackgroundOps)
     from ..crawler import DataCrawler
+    from ..objectlayer.api import META_BUCKET
 
     srv.crawler = DataCrawler(
         ol,
@@ -393,6 +429,18 @@ def main(argv=None) -> int:
         events=srv.events,
         ensure_event_rules=srv.ensure_event_rules,
         replication=srv.replication,
+        cycle_bloom=_cluster_bloom,
+        # distributed: elect one sweeping node per cycle via the lock
+        # plane (single node: the local _crawl_mu already serializes)
+        leader_lock=(
+            (
+                lambda: nslock.write(
+                    META_BUCKET, "data-crawler/leader", timeout=2.0
+                )
+            )
+            if peers
+            else None
+        ),
     ).start()
     si = ol.storage_info()
     if "zones" in si:
@@ -412,6 +460,7 @@ def main(argv=None) -> int:
     )
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}, shutting down")
+    tracker.save()  # flush marks recorded since the last rotation
     srv.shutdown()
     return 0
 

@@ -237,3 +237,46 @@ def test_zones_multipart_pinning(zones):
     assert buf.getvalue() == b"dd"
     with pytest.raises(api.InvalidUploadID):
         zones.put_object_part("bucket", "mp", "9.bogus", 1, io.BytesIO(b""), 0)
+
+
+# ---------------------------------------------------------------------------
+# placement (erasure-zones.go:113-184 semantics)
+# ---------------------------------------------------------------------------
+
+
+def test_zones_placement_deterministic(zones):
+    idx = [zones._put_zone_index("bucket", f"new-{i}", 100)
+           for i in range(20)]
+    # same keys -> same zones, every time (no randomness)
+    assert idx == [zones._put_zone_index("bucket", f"new-{i}", 100)
+                   for i in range(20)]
+    # and with roughly equal free space both zones receive keys
+    assert set(idx) == {0, 1}
+
+
+def test_zones_placement_skips_full_zone(zones, monkeypatch):
+    # zone 0 reports no headroom: everything must land in zone 1
+    snap = [(10, 1000), (10**9, 2 * 10**9)]
+    monkeypatch.setattr(zones, "_usage_snapshot", lambda: snap)
+    for i in range(10):
+        assert zones._put_zone_index("bucket", f"full-{i}", 100) == 1
+    # too-big object for every zone: falls back to most-free zone
+    assert zones._put_zone_index("bucket", "huge", 10**12) == 1
+
+
+def test_zones_single_zone_no_probe(tmp_path):
+    z1 = ErasureSets(_disks(tmp_path, 4, "sz"), 1, 4, block_size=BLOCK)
+    z = ErasureZones([z1])
+    calls = []
+    orig = z1.get_object_info
+    z1.get_object_info = lambda *a, **k: (calls.append(a), orig(*a, **k))[1]
+    assert z._put_zone_index("bucket", "obj", 5) == 0
+    assert calls == []  # single-zone placement never stats
+
+
+def test_zones_usage_snapshot_cached(zones):
+    zones._put_zone_index("bucket", "warm", 1)
+    stamped = zones._usage_ts
+    for i in range(5):
+        zones._put_zone_index("bucket", f"c{i}", 1)
+    assert zones._usage_ts == stamped  # no re-stat within the TTL

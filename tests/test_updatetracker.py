@@ -1,0 +1,277 @@
+"""Data update tracker: bloom journal + crawler skip
+(cmd/data-update-tracker.go)."""
+
+import io
+
+import pytest
+
+from minio_tpu.crawler import DataCrawler
+from minio_tpu.crawler import updatetracker as ut
+from minio_tpu.objectlayer.bucket_meta import BucketMetadataSys
+from minio_tpu.objectlayer.sets import ErasureSets
+from minio_tpu.objectlayer.zones import ErasureZones
+from minio_tpu.storage.xl import XLStorage
+
+BLOCK = 2048
+
+
+# ---------------------------------------------------------------------------
+# bloom filter
+# ---------------------------------------------------------------------------
+
+
+def test_bloom_membership_and_dirs():
+    bf = ut.BloomFilter(m=2**14, k=5)
+    bf.add("bucket/a/b")
+    assert "bucket/a/b" in bf
+    assert bf.contains_dir("/bucket/a/b/")
+    assert "bucket/other" not in bf
+    assert not bf.contains_dir("elsewhere")
+
+
+def test_bloom_no_false_negatives():
+    bf = ut.BloomFilter(m=2**16, k=5)
+    keys = [f"b/{i}" for i in range(500)]
+    for k in keys:
+        bf.add(k)
+    assert all(k in bf for k in keys)
+
+
+def test_bloom_union_and_wire_roundtrip():
+    a = ut.BloomFilter(m=2**14, k=5)
+    b = ut.BloomFilter(m=2**14, k=5)
+    a.add("x")
+    b.add("y")
+    a.union_into(b)
+    assert "x" in a and "y" in a
+    back = ut.BloomFilter.from_bytes(a.m, a.k, a.to_bytes())
+    assert "x" in back and "y" in back
+    with pytest.raises(ValueError):
+        a.union_into(ut.BloomFilter(m=2**13, k=5))
+
+
+def test_split_path_deterministic():
+    assert ut.split_path_deterministic("/b/a/c/d/e/") == ["b", "a", "c"]
+    assert ut.split_path_deterministic("./b") == ["b"]
+    assert ut.split_path_deterministic("///") == []
+
+
+# ---------------------------------------------------------------------------
+# tracker cycling + persistence
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_cycle_semantics():
+    t = ut.DataUpdateTracker(m=2**14)
+    t.mark("bkt/deep/key/below/cap")
+    # first rotation serves filter 0, which holds the pre-sweep marks
+    r1 = t.cycle_filter(0, 1)
+    assert r1.complete
+    assert r1.filter.contains_dir("bkt")
+    assert r1.filter.contains_dir("bkt/deep")
+    assert r1.filter.contains_dir("bkt/deep/key")  # capped at 3 levels
+    assert not r1.filter.contains_dir("bkt/deep/key/below")
+    assert not r1.filter.contains_dir("clean-bucket")
+    # nothing marked since: next window is complete and empty
+    r2 = t.cycle_filter(1, 2)
+    assert r2.complete
+    assert not r2.filter.contains_dir("bkt")
+    # marks between rotations surface in the following window only
+    t.mark("bkt2/x")
+    r3 = t.cycle_filter(2, 3)
+    assert r3.complete and r3.filter.contains_dir("bkt2")
+    assert not t.cycle_filter(3, 4).filter.contains_dir("bkt2")
+
+
+def test_tracker_reserved_paths_ignored():
+    t = ut.DataUpdateTracker(m=2**14)
+    t.mark(".minio.sys/data-usage/usage.json")
+    r = t.cycle_filter(0, 1)
+    assert r.complete
+    assert not r.filter.contains_dir(".minio.sys")
+
+
+def test_tracker_persistence_and_restart_distrust(tmp_path):
+    p = str(tmp_path / "tracker.bin")
+    t = ut.DataUpdateTracker(path=p, m=2**14)
+    t.mark("b1/k")
+    t.cycle_filter(0, 1)  # rotation saves
+    t.mark("b2/k")
+    t.save()
+
+    # a new process loads the snapshot...
+    t2 = ut.DataUpdateTracker(path=p, m=2**14)
+    assert t2.current() == 1
+    # ...but the in-flight cycle (idx 1) may have lost late marks:
+    # windows touching it must read incomplete, forcing a full sweep
+    r = t2.cycle_filter(0, 2)
+    assert not r.complete
+    assert r.filter.contains_dir("b1")  # history still usable
+    # once the untrusted cycle ages out of the window, trust returns
+    r = t2.cycle_filter(2, 3)
+    assert r.complete
+
+
+def test_bloom_response_wire_roundtrip():
+    t = ut.DataUpdateTracker(m=2**14)
+    t.mark("b/k")
+    resp = t.cycle_filter(0, 1)
+    back = ut.BloomResponse.from_wire(resp.to_wire())
+    assert back.complete == resp.complete
+    assert back.filter.contains_dir("b")
+
+
+# ---------------------------------------------------------------------------
+# crawler integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def zones(tmp_path):
+    z1 = ErasureSets(
+        [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)],
+        1, 4, block_size=BLOCK,
+    )
+    z = ErasureZones([z1])
+    z.make_bucket("hot")
+    z.make_bucket("cold")
+    yield z
+    ut.install_tracker(None)
+
+
+def _counting_crawler(zones, tracker):
+    meta = BucketMetadataSys(zones, cache_ttl_s=0)
+    crawler = DataCrawler(zones, meta, sleep_every=0, tracker=tracker)
+    swept = []
+    orig = crawler._crawl_bucket
+
+    def counting(bucket):
+        swept.append(bucket)
+        return orig(bucket)
+
+    crawler._crawl_bucket = counting
+    return crawler, swept
+
+
+def test_crawler_skips_clean_buckets(zones):
+    tracker = ut.DataUpdateTracker(m=2**14)
+    ut.install_tracker(tracker)
+    crawler, swept = _counting_crawler(zones, tracker)
+
+    zones.put_object("hot", "a", io.BytesIO(b"x"), 1)
+    zones.put_object("cold", "b", io.BytesIO(b"y"), 1)
+    crawler.crawl_once()  # first sweep: always full
+    assert sorted(swept) == ["cold", "hot"]
+
+    swept.clear()
+    crawler.crawl_once()  # nothing changed: everything skipped
+    assert swept == []
+    # cached usage survives the skip
+    assert crawler.usage().buckets["cold"].objects == 1
+
+    zones.put_object("hot", "a2", io.BytesIO(b"z"), 1)
+    swept.clear()
+    crawler.crawl_once()  # only the dirty bucket is re-swept
+    assert swept == ["hot"]
+    assert crawler.usage().buckets["hot"].objects == 2
+
+
+def test_crawler_never_skips_lifecycle_buckets(zones):
+    tracker = ut.DataUpdateTracker(m=2**14)
+    ut.install_tracker(tracker)
+    crawler, swept = _counting_crawler(zones, tracker)
+    crawler._meta.update(
+        "hot",
+        lifecycle_xml=(
+            "<LifecycleConfiguration><Rule><ID>r</ID>"
+            "<Status>Enabled</Status><Filter><Prefix></Prefix></Filter>"
+            "<Expiration><Days>30</Days></Expiration>"
+            "</Rule></LifecycleConfiguration>"
+        ),
+    )
+    crawler.crawl_once()
+    swept.clear()
+    crawler.crawl_once()
+    # lifecycle bucket swept despite zero writes; plain bucket skipped
+    assert swept == ["hot"]
+
+
+def test_crawler_full_sweep_every_16(zones):
+    tracker = ut.DataUpdateTracker(m=2**14)
+    ut.install_tracker(tracker)
+    crawler, swept = _counting_crawler(zones, tracker)
+    crawler.crawl_once()
+    for _ in range(13):
+        crawler.crawl_once()
+    swept.clear()
+    crawler.crawl_once()  # cycle 15: still skipping
+    assert swept == []
+    crawler.crawl_once()  # cycle 16: forced full sweep
+    assert sorted(swept) == ["cold", "hot"]
+
+
+def test_crawler_delete_marks_dirty(zones):
+    tracker = ut.DataUpdateTracker(m=2**14)
+    ut.install_tracker(tracker)
+    crawler, swept = _counting_crawler(zones, tracker)
+    zones.put_object("hot", "a", io.BytesIO(b"x"), 1)
+    crawler.crawl_once()
+    zones.delete_object("hot", "a")
+    swept.clear()
+    crawler.crawl_once()
+    assert swept == ["hot"]
+    assert crawler.usage().buckets["hot"].objects == 0
+
+
+# ---------------------------------------------------------------------------
+# review hardening: stale callers, crash windows, crawl leadership
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_never_rewinds_for_stale_caller():
+    t = ut.DataUpdateTracker(m=2**14)
+    t.cycle_filter(0, 1)
+    t.cycle_filter(1, 2)
+    t.mark("live/k")
+    # a node whose counter is cycles behind must not rotate backward
+    r = t.cycle_filter(0, 1)
+    assert not r.complete
+    assert t.current() == 2
+    assert "live/k".split()[0]  # live filter untouched
+    assert t.cycle_filter(2, 3).filter.contains_dir("live")
+
+
+def test_tracker_untrusted_live_cycle_blocks_completeness(tmp_path):
+    p = str(tmp_path / "t.bin")
+    t = ut.DataUpdateTracker(path=p, m=2**14)
+    t.cycle_filter(0, 1)  # saved: idx 1 live
+    # crash + restart: idx 1 may have lost marks and NO rotation has
+    # happened yet - a window ending at the live cycle cannot be
+    # complete even though it excludes the live filter
+    t2 = ut.DataUpdateTracker(path=p, m=2**14)
+    assert not t2.cycle_filter(0, 1).complete
+
+
+def test_crawler_skips_sweep_without_leadership(zones):
+    from minio_tpu.dsync.namespace import LockTimeout
+
+    tracker = ut.DataUpdateTracker(m=2**14)
+    ut.install_tracker(tracker)
+    crawler, swept = _counting_crawler(zones, tracker)
+    zones.put_object("hot", "a", io.BytesIO(b"x"), 1)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def denied():
+        raise LockTimeout("data-crawler/leader")
+        yield
+
+    crawler._leader_lock = denied
+    crawler.crawl_once()
+    assert swept == []  # follower: no sweep, no tracker rotation
+    assert tracker.current() == 0
+
+    crawler._leader_lock = None
+    crawler.crawl_once()
+    assert sorted(swept) == ["cold", "hot"]
