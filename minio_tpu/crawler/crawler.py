@@ -270,7 +270,7 @@ class DataCrawler:
 
     # -- the sweep --------------------------------------------------------
 
-    def crawl_once(self) -> DataUsage:
+    def crawl_once(self, force: bool = False) -> DataUsage:
         # one sweep at a time: an admin-triggered crawl and the
         # background cycle must not interleave deletes or publish
         # out-of-order usage snapshots
@@ -281,9 +281,34 @@ class DataCrawler:
 
             try:
                 with self._leader_lock():
-                    return self._crawl_locked()
+                    # the lock serializes sweeps; ONE sweep per
+                    # interval needs a freshness gate too, or K nodes
+                    # each sweep the shared namespace once per
+                    # interval, staggered by lock turnover
+                    prev = self._load_usage()
+                    if not force:
+                        age_ns = time.time_ns() - prev.last_update_ns
+                        # a NEGATIVE age (another node's future clock,
+                        # or NTP stepping ours back) must read as
+                        # stale, or a dead fast-clock leader would
+                        # gate the whole cluster off sweeping
+                        if (
+                            prev.last_update_ns
+                            and 0
+                            <= age_ns
+                            < self._effective_interval() * 0.5e9
+                        ):
+                            with self._mu:
+                                self._usage = prev
+                            return prev
+                    return self._crawl_locked(prev)
             except LockTimeout:
-                # another node holds crawl leadership this cycle
+                # another node holds crawl leadership this cycle;
+                # serve ITS published numbers, not our boot snapshot
+                fresh = self._load_usage()
+                if fresh.last_update_ns:
+                    with self._mu:
+                        self._usage = fresh
                 return self.usage()
 
     def _rotate_bloom(self, oldest: int, current: int):
@@ -312,13 +337,15 @@ class DataCrawler:
         repl = self._replication
         return repl is not None and repl.config_for(bucket) is not None
 
-    def _crawl_locked(self) -> DataUsage:
-        # re-read the persisted snapshot: in distributed mode crawl
-        # leadership floats between nodes and the cycle counter lives
-        # in the (shared) usage document, not in process memory - a
-        # node that was follower for N cycles must not rewind the
-        # cluster's bloom trackers with its stale cached counter
-        prev = self._load_usage()
+    def _crawl_locked(self, prev: "DataUsage | None" = None) -> DataUsage:
+        # re-read the persisted snapshot (unless the caller already
+        # did): in distributed mode crawl leadership floats between
+        # nodes and the cycle counter lives in the (shared) usage
+        # document, not in process memory - a node that was follower
+        # for N cycles must not rewind the cluster's bloom trackers
+        # with its stale cached counter
+        if prev is None:
+            prev = self._load_usage()
         if prev.last_update_ns == 0 and prev.cycles == 0:
             prev = self.usage()  # store unreadable: trust memory
         next_cycle = prev.cycles + 1

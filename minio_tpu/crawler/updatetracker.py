@@ -27,12 +27,8 @@ import os
 import threading
 import zlib
 
+import msgpack
 import numpy as np
-
-try:
-    import msgpack
-except ImportError:  # pragma: no cover
-    msgpack = None
 
 # ~1% false-positive rate at ~440k distinct dirty prefixes; a false
 # positive only costs one needless bucket crawl
@@ -255,7 +251,7 @@ class DataUpdateTracker:
         plus already-compressed history blobs.  Compression of the
         live filter and the file write happen in _write_snapshot,
         outside the mark/rotate lock."""
-        if not self._path or msgpack is None:
+        if not self._path:
             return None
         self._snap_seq += 1
         return {
@@ -296,31 +292,36 @@ class DataUpdateTracker:
         self._write_snapshot(snap)
 
     def _load(self) -> None:
-        if msgpack is None:
-            return
         try:
             with open(self._path, "rb") as f:
                 doc = msgpack.unpackb(f.read(), strict_map_key=False)
         except (OSError, ValueError):
             return
+        # parse into locals first: a partially-corrupt snapshot must
+        # not leave half-adopted state behind (worse, state adopted
+        # WITHOUT the untrusted marking below)
         try:
             if (doc["m"], doc["k"]) != (self.m, self.k):
                 return  # shape changed: start fresh
-            self.current_idx = doc["idx"]
-            self.cur = BloomFilter(self.m, self.k, zlib.decompress(doc["cur"]))
-            self.history = {
+            idx = int(doc["idx"])
+            cur = BloomFilter(self.m, self.k, zlib.decompress(doc["cur"]))
+            history = {
                 int(i): BloomFilter.from_bytes(self.m, self.k, raw)
                 for i, raw in doc.get("hist", {}).items()
             }
-            self._hist_blobs = {
+            hist_blobs = {
                 int(i): raw for i, raw in doc.get("hist", {}).items()
             }
-            self.untrusted = set(doc.get("untrusted", []))
-        except (KeyError, ValueError, zlib.error):
+            untrusted = set(doc.get("untrusted", []))
+        except (KeyError, TypeError, ValueError, zlib.error):
             return
+        self.current_idx = idx
+        self.cur = cur
+        self.history = history
+        self._hist_blobs = hist_blobs
         # marks after the last save died with the old process: the
         # in-flight cycle cannot be trusted for skipping
-        self.untrusted.add(self.current_idx)
+        self.untrusted = untrusted | {idx}
 
 
 # -- process-wide mark hook (ObjectPathUpdated,

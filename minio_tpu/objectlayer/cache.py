@@ -18,7 +18,6 @@ import json
 import os
 import shutil
 import threading
-import time
 
 from .api import ObjectInfo, ObjectNotFound
 
@@ -56,7 +55,10 @@ class _CacheDrive:
     # -- lookup -----------------------------------------------------------
 
     def get(self, bucket: str, key: str) -> "tuple[str, dict] | None":
-        """(data_path, meta) when cached; touches atime for LRU."""
+        """(data_path, meta) when cached; touches the data file's
+        mtime for LRU.  meta.json is never rewritten on the read path:
+        an in-place rewrite would race concurrent readers into
+        spurious misses (and re-population)."""
         d = self._entry_dir(bucket, key)
         data, meta_p = os.path.join(d, "data"), os.path.join(d, "meta.json")
         try:
@@ -66,10 +68,8 @@ class _CacheDrive:
             return None
         if not os.path.isfile(data):
             return None
-        meta["atime"] = time.time()
         try:
-            with open(meta_p, "w", encoding="utf-8") as f:
-                json.dump(meta, f)
+            os.utime(data)  # LRU recency = data-file mtime
         except OSError:
             pass
         return data, meta
@@ -91,14 +91,21 @@ class _CacheDrive:
                 )
         d = self._entry_dir(bucket, key)
         os.makedirs(d, exist_ok=True)
-        os.replace(data_path_tmp, os.path.join(d, "data"))
-        meta = {**meta, "atime": time.time(), "size": size}
-        with open(
-            os.path.join(d, "meta.json"), "w", encoding="utf-8"
-        ) as f:
+        data_p = os.path.join(d, "data")
+        # re-population overwrites a stale entry in place: its old
+        # bytes leave the accounting as the new ones enter
+        try:
+            old_size = os.path.getsize(data_p)
+        except OSError:
+            old_size = 0
+        os.replace(data_path_tmp, data_p)
+        meta = {**meta, "size": size}
+        tmp = os.path.join(d, "meta.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
             json.dump(meta, f)
+        os.replace(tmp, os.path.join(d, "meta.json"))
         with self._mu:
-            self._used += size
+            self._used += size - old_size
 
     def invalidate(self, bucket: str, key: str) -> None:
         d = self._entry_dir(bucket, key)
@@ -124,12 +131,12 @@ class _CacheDrive:
                     with open(
                         os.path.join(d, "meta.json"), encoding="utf-8"
                     ) as f:
-                        meta = json.load(f)
-                    size = os.path.getsize(os.path.join(d, "data"))
+                        json.load(f)  # unreadable meta -> reap entry
+                    st = os.stat(os.path.join(d, "data"))
                 except (OSError, ValueError):
                     shutil.rmtree(d, ignore_errors=True)
                     continue
-                out.append((meta.get("atime", 0.0), size, d))
+                out.append((st.st_mtime, st.st_size, d))
         return out
 
     def _gc_locked(self, target_used: int) -> None:

@@ -113,7 +113,8 @@ class AdminAPI:
             crawler = getattr(self.s3, "crawler", None)
             if crawler is None:
                 raise S3Error("ServerNotInitialized")
-            return 200, _json(crawler.crawl_once().to_dict())
+            # an explicit admin crawl bypasses the freshness gate
+            return 200, _json(crawler.crawl_once(force=True).to_dict())
         # bucket quota (admin SetBucketQuota / GetBucketQuotaConfig)
         if route == ("GET", "get-bucket-quota"):
             ol.get_bucket_info(_req(q, "bucket"))

@@ -340,6 +340,10 @@ class S3Server:
         if self._thread:
             self._thread.join(timeout=5)
         self.events.shutdown()
+        # detach the console ring from the shared package logger: a
+        # process constructing several servers (tests, embedders) must
+        # not accumulate one live handler per dead server
+        self.console.uninstall()
 
     @property
     def endpoint(self) -> str:
@@ -772,14 +776,12 @@ class _Handler(BaseHTTPRequestHandler):
         # poll positions: ours + one per peer
         local_seq, _ = self.s3.tracer.poll(1 << 62) if kind == "trace" \
             else local_ring.since(1 << 62)
-        peer_seq = {id(p): 0 for p in peers}
-        # peers start from NOW, not their whole ring history
-        for p in peers:
-            try:
-                res = p.call(f"{kind}buf", {"since": str(1 << 62)})
-                peer_seq[id(p)] = res.get("seq", 0)
-            except Exception:  # noqa: BLE001
-                pass
+        # peers start from NOW, not their whole ring history: a None
+        # cursor means "not handshaken yet" and triggers a probe with
+        # since=1<<62 (whose items are discarded) on the next loop
+        # turn - an unreachable peer simply stays None until it
+        # answers, never replaying its ring from cursor 0
+        peer_seq: "dict[int, int | None]" = {id(p): None for p in peers}
         deadline = _time.monotonic() + duration
         while _time.monotonic() < deadline:
             batch: list = []
@@ -789,14 +791,18 @@ class _Handler(BaseHTTPRequestHandler):
                 local_seq, items = local_ring.since(local_seq)
             batch.extend(items)
             for p in peers:
+                pseq = peer_seq[id(p)]
                 try:
                     res = p.call(
-                        f"{kind}buf", {"since": str(peer_seq[id(p)])}
+                        f"{kind}buf",
+                        {"since": str(1 << 62 if pseq is None else pseq)},
                     )
-                    peer_seq[id(p)] = res.get("seq", peer_seq[id(p)])
-                    batch.extend(res.get("items", []))
                 except Exception:  # noqa: BLE001
-                    pass
+                    continue
+                if "seq" in res:
+                    peer_seq[id(p)] = res["seq"]
+                if pseq is not None:
+                    batch.extend(res.get("items", []))
             batch.sort(key=lambda e: e.get("time", 0))
             try:
                 for item in batch:

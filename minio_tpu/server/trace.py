@@ -40,12 +40,14 @@ class SeqRing:
             return self._seq
 
     def since(self, seq: int, limit: int = 1000) -> "tuple[int, list]":
-        """Entries with sequence > seq -> (latest_seq, items)."""
+        """Entries with sequence > seq -> (cursor, items).  The cursor
+        is the sequence of the LAST RETURNED item - when `limit`
+        truncates, the remainder is picked up by the next poll rather
+        than silently skipped."""
         with self._mu:
-            items = [
-                it for s, it in self._buf if s > seq
-            ][:limit]
-            return self._seq, items
+            pairs = [(s, it) for s, it in self._buf if s > seq][:limit]
+            cursor = pairs[-1][0] if pairs else self._seq
+            return cursor, [it for _, it in pairs]
 
 
 class Tracer:
@@ -159,3 +161,6 @@ class ConsoleCapture(logging.Handler):
         # so capture must attach at "minio_tpu", not the root
         logging.getLogger("minio_tpu").addHandler(self)
         return self
+
+    def uninstall(self) -> None:
+        logging.getLogger("minio_tpu").removeHandler(self)

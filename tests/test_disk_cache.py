@@ -134,3 +134,47 @@ def test_passthrough_methods(layers):
     res = cache.list_objects("bkt")
     assert "listed" in [o.name for o in res.objects]
     assert cache.storage_info()["disks"] == 4
+
+
+def test_repopulate_does_not_double_count(layers):
+    """Refreshing a stale entry in place must swap its bytes in the
+    accounting, not add them again (review r4)."""
+    backend, cache = layers
+    drive_used = lambda: sum(d.used for d in cache.drives)
+    data = os.urandom(4000)
+    cache.put_object("bkt", "obj", io.BytesIO(data), len(data))
+    _get(cache, "obj")  # populate
+    base = drive_used()
+    # mutate the backend BEHIND the cache (as another node would)
+    backend.put_object("bkt", "obj", io.BytesIO(data[::-1]), len(data))
+    for _ in range(5):
+        _get(cache, "obj")  # etag mismatch -> repopulate each time?
+    # only one copy of the object may ever be accounted
+    assert drive_used() == base
+
+
+def test_concurrent_hits_no_meta_race(layers):
+    """The read path must not rewrite meta.json (a truncate+write
+    races other readers into spurious misses)."""
+    import threading
+
+    backend, cache = layers
+    data = os.urandom(6000)
+    cache.put_object("bkt", "obj", io.BytesIO(data), len(data))
+    _get(cache, "obj")  # populate
+    errs = []
+
+    def reader():
+        try:
+            for _ in range(30):
+                assert _get(cache, "obj") == data
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert cache.misses == 1  # every later read was a clean hit

@@ -166,3 +166,29 @@ def test_peer_trace_buf(server):
     assert any(i["api"] == "MakeBucket" for i in doc["items"]) or any(
         i["api"] == "CreateBucket" for i in doc["items"]
     )
+
+
+def test_seqring_truncation_keeps_cursor():
+    """When `limit` truncates, the cursor must point at the last
+    RETURNED item so the remainder is delivered next poll (review
+    r4), not silently skipped."""
+    r = SeqRing(maxlen=100)
+    for i in range(30):
+        r.append({"n": i})
+    seq, items = r.since(0, limit=10)
+    assert [i["n"] for i in items] == list(range(10))
+    assert seq == 10
+    seq, items = r.since(seq, limit=10)
+    assert [i["n"] for i in items] == list(range(10, 20))
+    seq, items = r.since(seq, limit=100)
+    assert [i["n"] for i in items] == list(range(20, 30))
+    assert r.since(seq)[1] == []
+
+
+def test_console_capture_uninstall_on_shutdown(server):
+    import logging
+
+    handlers = logging.getLogger("minio_tpu").handlers
+    assert server.console in handlers
+    server.shutdown(drain_s=0.1)
+    assert server.console not in handlers

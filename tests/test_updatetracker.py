@@ -237,7 +237,7 @@ def test_tracker_never_rewinds_for_stale_caller():
     r = t.cycle_filter(0, 1)
     assert not r.complete
     assert t.current() == 2
-    assert "live/k".split()[0]  # live filter untouched
+    assert t.cur.contains_dir("live")  # live filter untouched
     assert t.cycle_filter(2, 3).filter.contains_dir("live")
 
 
@@ -275,3 +275,27 @@ def test_crawler_skips_sweep_without_leadership(zones):
     crawler._leader_lock = None
     crawler.crawl_once()
     assert sorted(swept) == ["cold", "hot"]
+
+
+def test_crawler_freshness_gate_under_leadership(zones):
+    """With leadership won, a sweep younger than half the interval is
+    not repeated (K nodes must not each sweep once per interval);
+    admin-forced crawls bypass the gate."""
+    import contextlib
+
+    tracker = ut.DataUpdateTracker(m=2**14)
+    ut.install_tracker(tracker)
+    crawler, swept = _counting_crawler(zones, tracker)
+
+    @contextlib.contextmanager
+    def granted():
+        yield
+
+    crawler._leader_lock = granted
+    crawler.crawl_once()
+    assert sorted(swept) == ["cold", "hot"]
+    assert crawler.usage().cycles == 1
+    crawler.crawl_once()  # fresh: gated off entirely, no new cycle
+    assert crawler.usage().cycles == 1
+    crawler.crawl_once(force=True)  # admin trigger bypasses the gate
+    assert crawler.usage().cycles == 2
