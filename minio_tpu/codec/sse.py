@@ -50,6 +50,9 @@ META_SSE_SEALED_KEY = "x-internal-sse-sealed-key"
 META_SSE_NONCE = "x-internal-sse-nonce"
 META_SSE_KEY_MD5 = "x-internal-sse-key-md5"
 META_SSE_KMS_ID = "x-internal-sse-kms-id"
+# the per-object data key sealed by the KMS (crypto.S3KMSSealedKey);
+# the OEK is sealed under this data key, not the master key directly
+META_SSE_KMS_SEALED_DK = "x-internal-sse-kms-sealed-dk"
 # original (client) part numbers, comma-separated: chunk nonces derive
 # from the number the part was UPLOADED under, which complete's
 # renumbering would otherwise lose
@@ -91,10 +94,11 @@ def master_key() -> "tuple[str, bytes]":
 
 
 def sse_s3_available() -> bool:
+    from . import kms as kmsmod
+
     try:
-        master_key()
-        return True
-    except SSEError:
+        return kmsmod.get_kms() is not None
+    except kmsmod.KMSError:
         return False
 
 

@@ -202,6 +202,9 @@ class DataCrawler:
                 successor_mod_time_ns=getattr(
                     oi, "successor_mod_time_ns", 0
                 ),
+                user_tags=(oi.user_defined or {}).get(
+                    "x-amz-tagging", ""
+                ),
             )
         )
         dinfo = None

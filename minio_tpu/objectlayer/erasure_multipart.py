@@ -148,6 +148,12 @@ class MultipartMixin:
         # the plaintext MD5 the client computed
         compress = bool(mfi.metadata.get(compmod.META_COMPRESSION))
         src = compmod.CompressReader(hreader) if compress else hreader
+        if sse is not None and not mfi.metadata.get(ssemod.META_SSE):
+            # a key on a part of an UNENCRYPTED upload must fail, not
+            # be silently dropped onto plaintext storage
+            raise ssemod.SSEError(
+                "upload was not initiated with server-side encryption"
+            )
         if mfi.metadata.get(ssemod.META_SSE):
             bkt = mfi.metadata.get("x-internal-bucket", bucket)
             obj = mfi.metadata.get("x-internal-object", object_name)
@@ -391,6 +397,7 @@ class MultipartMixin:
                 ssemod.META_SSE_NONCE,
                 ssemod.META_SSE_KEY_MD5,
                 ssemod.META_SSE_KMS_ID,
+                ssemod.META_SSE_KMS_SEALED_DK,
             ):
                 if mk in mfi.metadata:
                     meta[mk] = mfi.metadata[mk]

@@ -497,8 +497,25 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 }
             )
             return out
-        kid, mk = ssemod.master_key()
-        sealed = ssemod.seal_key(mk, oek, aad)
+        # SSE-S3 key hierarchy (cmd/crypto/kms.go): the KMS mints a
+        # per-object data key; the OEK seals under the data key and
+        # only the KMS-sealed data key is persisted, so an external
+        # KMS (KES) never sees object keys and master rotation never
+        # re-touches objects
+        from ..codec import kms as kmsmod
+
+        kms = kmsmod.get_kms()
+        if kms is None:
+            raise ssemod.SSEError(
+                "SSE-S3 requires a KMS (MINIO_TPU_KMS_MASTER_KEY or "
+                "MINIO_TPU_KMS_KES_ENDPOINT)"
+            )
+        kid = kms.default_key_id()
+        try:
+            dk, sealed_dk = kms.generate_key(kid, {"path": aad})
+        except kmsmod.KMSError as e:
+            raise ssemod.SSEError(str(e)) from None
+        sealed = ssemod.seal_key(dk, oek, aad)
         out.update(
             {
                 ssemod.META_SSE: "S3",
@@ -506,6 +523,9 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     sealed
                 ).decode(),
                 ssemod.META_SSE_KMS_ID: kid,
+                ssemod.META_SSE_KMS_SEALED_DK: base64.b64encode(
+                    sealed_dk
+                ).decode(),
             }
         )
         return out
@@ -533,7 +553,27 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     "provided SSE-C key does not match the object key"
                 )
             kek = sse.key
+        elif fi_meta.get(ssemod.META_SSE_KMS_SEALED_DK):
+            from ..codec import kms as kmsmod
+
+            kms = kmsmod.get_kms()
+            if kms is None:
+                raise ssemod.SSEError(
+                    "object is KMS-encrypted but no KMS is configured"
+                )
+            try:
+                kek = kms.unseal_key(
+                    fi_meta.get(ssemod.META_SSE_KMS_ID, ""),
+                    base64.b64decode(
+                        fi_meta[ssemod.META_SSE_KMS_SEALED_DK]
+                    ),
+                    {"path": aad},
+                )
+            except kmsmod.KMSError as e:
+                raise ssemod.SSEError(str(e)) from None
         else:
+            # legacy layout: OEK sealed directly under the local
+            # master key (pre data-key objects)
             _, kek = ssemod.master_key()
         oek = ssemod.unseal_key(kek, sealed, aad)
         nb = base64.b64decode(fi_meta.get(ssemod.META_SSE_NONCE, ""))

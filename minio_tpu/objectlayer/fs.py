@@ -389,10 +389,12 @@ class FSObjects(ObjectLayer):
         return os.path.join(self.root, SYS_DIR, "multipart", upload_id)
 
     def new_multipart_upload(
-        self, bucket, object_name, metadata=None, **kw
+        self, bucket, object_name, metadata=None, sse=None, **kw
     ) -> str:
         check_object_name(object_name)
         self._require_bucket(bucket)
+        if sse is not None:
+            raise NotImplementedError("SSE on the FS backend")
         uid = uuid.uuid4().hex
         d = self._upload_dir(uid)
         os.makedirs(d)
@@ -425,10 +427,12 @@ class FSObjects(ObjectLayer):
 
     def put_object_part(
         self, bucket, object_name, upload_id, part_number, reader,
-        size=-1, **kw
+        size=-1, sse=None, **kw
     ):
         from .api import PartInfo
 
+        if sse is not None:
+            raise NotImplementedError("SSE on the FS backend")
         self._upload_doc(bucket, object_name, upload_id)
         hreader = (
             reader

@@ -102,6 +102,34 @@ class AdminAPI:
                     **({"local_error": local_err} if local_err else {}),
                 }
             )
+        # KMS key status (admin-handlers.go KMSKeyStatusHandler): a
+        # full generate->unseal roundtrip proves the configured KMS
+        # can both mint and open data keys for this key id
+        if route == ("GET", "kms/key/status"):
+            from ..codec import kms as kmsmod
+
+            kms = kmsmod.get_kms()
+            if kms is None:
+                raise S3Error(
+                    "InvalidArgument", "KMS is not configured"
+                )
+            key_id = q.get("key-id") or kms.default_key_id()
+            status = {"key-id": key_id, **kms.info()}
+            ctx = {"path": "admin/kms-status-check"}
+            try:
+                dk, sealed = kms.generate_key(key_id, ctx)
+                status["encryption"] = "success"
+            except kmsmod.KMSError as e:
+                status["encryption"] = f"failed: {e}"
+                return 200, _json(status)
+            try:
+                if kms.unseal_key(key_id, sealed, ctx) == dk:
+                    status["decryption"] = "success"
+                else:
+                    status["decryption"] = "failed: key mismatch"
+            except kmsmod.KMSError as e:
+                status["decryption"] = f"failed: {e}"
+            return 200, _json(status)
         if route == ("GET", "datausage"):
             crawler = getattr(self.s3, "crawler", None)
             if crawler is None:

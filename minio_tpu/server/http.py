@@ -1856,8 +1856,11 @@ class _Handler(BaseHTTPRequestHandler):
 
             meta[META_REPLICATION_STATUS] = "PENDING"
         hreader = HashReader(io.BytesIO(file_data), len(file_data))
+        # bucket-default encryption applies to POST uploads too (the
+        # form carries no SSE headers, so only the default can fire)
         info = self.s3.object_layer.put_object(
-            bucket, key, hreader, len(file_data), meta
+            bucket, key, hreader, len(file_data), meta,
+            sse=self._request_sse(bucket),
         )
         if replicate:
             self.s3.replication.queue(bucket, key, info.version_id)
@@ -1957,9 +1960,11 @@ class _Handler(BaseHTTPRequestHandler):
         ol = self.s3.object_layer
         version_id = query.get("versionId", [""])[0]
         info = ol.get_object_info(bucket, key, version_id)
+        sse = self._read_sse(info)
         self._check_conditions(info)
         rng = self._parse_range(info.size)
         headers = self._object_headers(info)
+        headers.update(self._sse_response_headers(info.user_defined))
         headers.pop("Content-Type-Override", None)
         # tag count rides GET responses only (GetObject API contract)
         tag_enc = info.user_defined.get("x-amz-tagging", "")
@@ -1986,7 +1991,8 @@ class _Handler(BaseHTTPRequestHandler):
         if length:
             try:
                 ol.get_object(
-                    bucket, key, self.wfile, lo, length, version_id
+                    bucket, key, self.wfile, lo, length, version_id,
+                    sse,
                 )
                 self._resp_bytes += length
             except Exception:  # noqa: BLE001
@@ -2008,8 +2014,10 @@ class _Handler(BaseHTTPRequestHandler):
         info = self.s3.object_layer.get_object_info(
             bucket, key, version_id
         )
+        self._read_sse(info)  # key required (and checked) for HEAD too
         self._check_conditions(info)
         headers = self._object_headers(info)
+        headers.update(self._sse_response_headers(info.user_defined))
         headers.pop("Content-Type-Override", None)
         self.send_response(200)
         self.send_header("Server", "MinIO-TPU")
@@ -2099,15 +2107,17 @@ class _Handler(BaseHTTPRequestHandler):
             from ..replication.replicate import META_REPLICATION_STATUS
 
             meta[META_REPLICATION_STATUS] = "PENDING"
+        sse = self._request_sse(bucket)
         # transparent compression (MINIO_TPU_COMPRESS) is decided inside
         # the object layer so POST-policy/multipart/copy share the seam
         info = self.s3.object_layer.put_object(
             bucket, key, hreader, size, meta,
-            versioned=versioned,
+            versioned=versioned, sse=sse,
         )
         if replicate:
             self.s3.replication.queue(bucket, key, info.version_id)
         hdrs = {"ETag": f'"{info.etag}"'}
+        hdrs.update(self._sse_response_headers(info.user_defined))
         if info.version_id:
             hdrs["x-amz-version-id"] = info.version_id
         from ..event.event import EventName
@@ -2117,6 +2127,191 @@ class _Handler(BaseHTTPRequestHandler):
             info.etag, info.size, info.version_id,
         )
         self._respond(200, b"", hdrs)
+
+    # -- server-side encryption plumbing (cmd/crypto/header.go,
+    #    cmd/encryption-v1.go) ----------------------------------------
+
+    def _parse_ssec_headers(self, prefix: str):
+        """SSESpec from the SSE-C header triplet under ``prefix``, or
+        None when absent.  Validation order and messages follow
+        crypto.SSEC.ParseHTTP (cmd/crypto/header.go:208)."""
+        algo = self.headers.get(f"{prefix}-algorithm")
+        key_b64 = self.headers.get(f"{prefix}-key")
+        md5_b64 = self.headers.get(f"{prefix}-key-MD5")
+        if algo is None and key_b64 is None and md5_b64 is None:
+            return None
+        if not getattr(self.s3, "tls", False):
+            # ErrInsecureSSECustomerRequest: keys must never ride
+            # plaintext HTTP
+            raise S3Error(
+                "InvalidRequest",
+                "Requests specifying Server Side Encryption with "
+                "Customer provided keys must be made over a secure "
+                "connection.",
+            )
+        if algo != "AES256":
+            raise S3Error(
+                "InvalidArgument",
+                "Requests specifying Server Side Encryption with "
+                "Customer provided keys must provide a valid "
+                "encryption algorithm.",
+            )
+        if not key_b64:
+            raise S3Error(
+                "InvalidArgument",
+                "Requests specifying Server Side Encryption with "
+                "Customer provided keys must provide an appropriate "
+                "secret key.",
+            )
+        if not md5_b64:
+            raise S3Error(
+                "InvalidArgument",
+                "Requests specifying Server Side Encryption with "
+                "Customer provided keys must provide the client "
+                "calculated MD5 of the secret key.",
+            )
+        import base64 as b64
+
+        from ..codec import sse as ssemod
+
+        try:
+            key = b64.b64decode(key_b64, validate=True)
+        except Exception:  # noqa: BLE001
+            raise S3Error(
+                "InvalidArgument", "The secret key was invalid."
+            ) from None
+        if len(key) != 32:
+            raise S3Error(
+                "InvalidArgument",
+                "The secret key was invalid for the specified "
+                "algorithm.",
+            )
+        if ssemod.key_md5_b64(key) != md5_b64:
+            raise S3Error(
+                "InvalidArgument",
+                "The calculated MD5 hash of the key did not match "
+                "the hash that was provided.",
+            )
+        return ssemod.SSESpec("C", key)
+
+    def _request_sse(self, bucket: str):
+        """Encryption intent of a write (PUT/copy-dest/initiate-
+        multipart): explicit SSE-C or SSE-S3 headers, else the
+        bucket's default encryption config.  SSE-KMS requests return
+        NotImplemented exactly like the reference
+        (object-handlers.go:102)."""
+        from ..codec import sse as ssemod
+
+        spec = self._parse_ssec_headers(
+            "x-amz-server-side-encryption-customer"
+        )
+        algo = self.headers.get("x-amz-server-side-encryption")
+        if spec is not None:
+            if algo:
+                raise S3Error(
+                    "InvalidRequest",
+                    "SSE-C and SSE-S3 headers are mutually exclusive",
+                )
+            return spec
+        if algo is not None:
+            if algo == "aws:kms":
+                raise S3Error("NotImplemented", "SSE-KMS")
+            if algo != "AES256":
+                raise S3Error(
+                    "InvalidRequest",
+                    "The encryption method specified is not supported",
+                )
+            if not ssemod.sse_s3_available():
+                raise S3Error(
+                    "InvalidArgument",
+                    "Server side encryption specified but KMS is not "
+                    "configured",
+                )
+            return ssemod.SSESpec("S3")
+        # bucket-default SSE (PutBucketEncryption config): applied
+        # when the request itself is silent (validateAndGetSSE)
+        try:
+            raw = self.s3.bucket_meta.get(bucket).sse_config_xml
+        except Exception:  # noqa: BLE001
+            raw = ""
+        if raw and self._default_sse_algo(raw) == "AES256":
+            if not ssemod.sse_s3_available():
+                # the bucket DEMANDS encryption: storing plaintext
+                # because the KMS went away would silently violate it
+                raise S3Error(
+                    "InvalidArgument",
+                    "Bucket default encryption is configured but KMS "
+                    "is not configured",
+                )
+            return ssemod.SSESpec("S3")
+        return None
+
+    @staticmethod
+    def _default_sse_algo(raw: str) -> str:
+        """SSEAlgorithm of the bucket's default-encryption rule
+        (parsed, not substring-matched)."""
+        try:
+            root = ET.fromstring(raw)
+        except ET.ParseError:
+            return ""
+        for el in root.iter():
+            if el.tag.split("}")[-1] == "SSEAlgorithm":
+                return (el.text or "").strip()
+        return ""
+
+    def _read_sse(self, info, copy_source: bool = False):
+        """Spec needed to READ ``info``; enforces that SSE-C objects
+        are fetched with their key and non-SSE-C objects without one
+        (getEncryptedObject guards, cmd/encryption-v1.go)."""
+        from ..codec import sse as ssemod
+
+        prefix = (
+            "x-amz-copy-source-server-side-encryption-customer"
+            if copy_source
+            else "x-amz-server-side-encryption-customer"
+        )
+        spec = self._parse_ssec_headers(prefix)
+        mode = (info.user_defined or {}).get(ssemod.META_SSE)
+        if mode == "C" and spec is None:
+            raise S3Error(
+                "InvalidRequest",
+                "The object was stored using a form of Server Side "
+                "Encryption. The correct parameters must be provided "
+                "to retrieve the object.",
+            )
+        if mode != "C" and spec is not None:
+            raise S3Error(
+                "InvalidRequest",
+                "Encryption parameters were provided but the object "
+                "is not encrypted with a customer key",
+            )
+        if mode == "C" and ssemod.key_md5_b64(spec.key) != (
+            info.user_defined.get(ssemod.META_SSE_KEY_MD5)
+        ):
+            # wrong key, detected BEFORE headers go out - a mid-stream
+            # decrypt failure can only abort the connection
+            raise S3Error(
+                "AccessDenied",
+                "The provided encryption key does not match the key "
+                "used to encrypt the object",
+            )
+        return spec if mode == "C" else None
+
+    @staticmethod
+    def _sse_response_headers(meta: dict) -> dict:
+        from ..codec import sse as ssemod
+
+        mode = (meta or {}).get(ssemod.META_SSE)
+        if mode == "C":
+            return {
+                "x-amz-server-side-encryption-customer-algorithm":
+                    "AES256",
+                "x-amz-server-side-encryption-customer-key-MD5":
+                    meta.get(ssemod.META_SSE_KEY_MD5, ""),
+            }
+        if mode == "S3":
+            return {"x-amz-server-side-encryption": "AES256"}
+        return {}
 
     def _parse_copy_source(self) -> "tuple[str, str]":
         """(bucket, key) from x-amz-copy-source - one parser for both
@@ -2150,6 +2345,8 @@ class _Handler(BaseHTTPRequestHandler):
         src_info = self.s3.object_layer.get_object_info(
             src_bucket, src_key
         )
+        sse_src = self._read_sse(src_info, copy_source=True)
+        sse_dst = self._request_sse(bucket)
         quotamod.enforce_put(self.s3, bucket, src_info.size)
         replicate = self.s3.replication.should_replicate(bucket, key)
         if replicate:
@@ -2167,7 +2364,8 @@ class _Handler(BaseHTTPRequestHandler):
             meta.update(lock_tag)
         versioned, _ = self._versioning(bucket)
         info = self.s3.object_layer.copy_object(
-            src_bucket, src_key, bucket, key, meta, versioned=versioned
+            src_bucket, src_key, bucket, key, meta,
+            versioned=versioned, sse_src=sse_src, sse=sse_dst,
         )
         if meta is None and lock_tag:
             # COPY directive keeps source metadata; lock/replication
@@ -2246,11 +2444,26 @@ class _Handler(BaseHTTPRequestHandler):
             from ..replication.replicate import META_REPLICATION_STATUS
 
             meta[META_REPLICATION_STATUS] = "PENDING"
+        sse = self._request_sse(bucket)
         uid = self.s3.object_layer.new_multipart_upload(
-            bucket, key, meta
+            bucket, key, meta, sse
         )
+        hdrs = {}
+        if sse is not None:
+            from ..codec import sse as ssemod
+
+            hdrs = (
+                {
+                    "x-amz-server-side-encryption-customer-algorithm":
+                        "AES256",
+                    "x-amz-server-side-encryption-customer-key-MD5":
+                        ssemod.key_md5_b64(sse.key),
+                }
+                if sse.mode == "C"
+                else {"x-amz-server-side-encryption": "AES256"}
+            )
         self._respond(
-            200, xmlr.initiate_multipart_xml(bucket, key, uid)
+            200, xmlr.initiate_multipart_xml(bucket, key, uid), hdrs
         )
 
     def _put_part(self, bucket, key, query):
@@ -2270,8 +2483,13 @@ class _Handler(BaseHTTPRequestHandler):
 
         quotamod.enforce_put(self.s3, bucket, size)
         hreader = self._hash_reader(reader, size)
+        # SSE-C uploads must present the key on every part
+        # (PutObjectPartHandler re-derives the seal per part)
+        part_sse = self._parse_ssec_headers(
+            "x-amz-server-side-encryption-customer"
+        )
         pi = self.s3.object_layer.put_object_part(
-            bucket, key, uid, pnum, hreader, size
+            bucket, key, uid, pnum, hreader, size, part_sse
         )
         self._respond(200, b"", {"ETag": f'"{pi.etag}"'})
 

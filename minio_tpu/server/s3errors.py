@@ -159,6 +159,12 @@ def from_exception(e: Exception) -> APIError:
     """toAPIError: translate layer exceptions to S3 codes."""
     if isinstance(e, S3Error):
         return e.err
+    from ..codec.sse import SSEError
+
+    if isinstance(e, SSEError):
+        # wrong key / missing KMS / tampered ciphertext
+        # (toAPIErrorCode maps crypto errors onto AccessDenied)
+        return get("AccessDenied", str(e))
     if isinstance(e, AuthError):
         return get(e.code, str(e) if str(e) else "")
     if isinstance(e, NotImplementedError):

@@ -276,7 +276,7 @@ def test_admin_datausage_endpoint(tmp_path):
         srv.shutdown()
 
 
-def test_filter_and_prefix_and_tag_rejection():
+def test_filter_and_prefix_and_tags():
     # <And>-nested prefix is honored
     lc = Lifecycle.from_xml(
         b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
@@ -285,15 +285,113 @@ def test_filter_and_prefix_and_tag_rejection():
         b"</Rule></LifecycleConfiguration>"
     )
     assert lc.rules[0].prefix == "tmp/"
-    # tag-scoped rules are rejected, never silently widened
-    with pytest.raises(LifecycleError, match="Tag"):
+    # tag-scoped rules parse (filter.go TestTags)
+    lc = Lifecycle.from_xml(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<Filter><And><Prefix>tmp/</Prefix>"
+        b"<Tag><Key>k</Key><Value>v</Value></Tag></And></Filter>"
+        b"<Expiration><Days>1</Days></Expiration>"
+        b"</Rule></LifecycleConfiguration>"
+    )
+    assert lc.rules[0].prefix == "tmp/"
+    assert lc.rules[0].tags == [("k", "v")]
+    # single-Tag filter form
+    lc = Lifecycle.from_xml(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<Filter><Tag><Key>cls</Key><Value>tmp</Value></Tag></Filter>"
+        b"<Expiration><Days>1</Days></Expiration>"
+        b"</Rule></LifecycleConfiguration>"
+    )
+    assert lc.rules[0].tags == [("cls", "tmp")]
+    # roundtrip preserves tag scoping
+    again = Lifecycle.from_xml(lc.to_xml())
+    assert again.rules[0].tags == [("cls", "tmp")]
+
+
+def test_filter_exactly_one_of_prefix_tag_and():
+    with pytest.raises(LifecycleError, match="exactly one"):
         Lifecycle.from_xml(
             b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
-            b"<Filter><And><Prefix>tmp/</Prefix>"
-            b"<Tag><Key>k</Key><Value>v</Value></Tag></And></Filter>"
+            b"<Filter><Prefix>a/</Prefix>"
+            b"<Tag><Key>k</Key><Value>v</Value></Tag></Filter>"
             b"<Expiration><Days>1</Days></Expiration>"
             b"</Rule></LifecycleConfiguration>"
         )
+
+
+def test_transition_rejected_loudly():
+    # the reference rejects Transition rules rather than ignoring
+    # them (errTransitionUnsupported)
+    with pytest.raises(LifecycleError, match="Transition"):
+        Lifecycle.from_xml(
+            b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+            b"<Filter><Prefix></Prefix></Filter>"
+            b"<Transition><Days>30</Days>"
+            b"<StorageClass>GLACIER</StorageClass></Transition>"
+            b"</Rule></LifecycleConfiguration>"
+        )
+
+
+def test_duplicate_rule_ids_rejected():
+    with pytest.raises(LifecycleError, match="duplicate"):
+        Lifecycle.from_xml(
+            b"<LifecycleConfiguration>"
+            b"<Rule><ID>r</ID><Status>Enabled</Status>"
+            b"<Expiration><Days>1</Days></Expiration></Rule>"
+            b"<Rule><ID>r</ID><Status>Enabled</Status>"
+            b"<Expiration><Days>2</Days></Expiration></Rule>"
+            b"</LifecycleConfiguration>"
+        )
+
+
+def test_tag_scoped_expiry_spares_untagged(layer):
+    """Only objects carrying the rule's tag expire; tags do NOT gate
+    the delete-marker/noncurrent actions (lifecycle.go:141-173)."""
+    from minio_tpu.ilm.lifecycle import ObjectOpts
+
+    lc = Lifecycle.from_xml(
+        b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+        b"<Filter><Tag><Key>tier</Key><Value>tmp</Value></Tag></Filter>"
+        b"<Expiration><Days>1</Days></Expiration>"
+        b"</Rule></LifecycleConfiguration>"
+    )
+    old = 10 * DAY_NS
+    now = 100 * DAY_NS
+    tagged = ObjectOpts(
+        name="a", mod_time_ns=old, user_tags="tier=tmp&x=y"
+    )
+    untagged = ObjectOpts(name="b", mod_time_ns=old)
+    wrong = ObjectOpts(name="c", mod_time_ns=old, user_tags="tier=hot")
+    assert lc.compute_action(tagged, now_ns=now) == "delete"
+    assert lc.compute_action(untagged, now_ns=now) == "none"
+    assert lc.compute_action(wrong, now_ns=now) == "none"
+
+
+def test_crawler_expires_by_tag(layer):
+    """End-to-end: the crawler reads x-amz-tagging off the version
+    metadata and only tag-matching objects expire."""
+    meta = BucketMetadataSys(layer, cache_ttl_s=0)
+    meta.update(
+        "ilm",
+        lifecycle_xml=(
+            "<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+            "<Filter><Tag><Key>tier</Key><Value>tmp</Value></Tag>"
+            "</Filter><Expiration><Days>30</Days></Expiration>"
+            "</Rule></LifecycleConfiguration>"
+        ),
+    )
+    layer.put_object(
+        "ilm", "tagged.txt", io.BytesIO(b"x" * 10), 10,
+        metadata={"x-amz-tagging": "tier=tmp"},
+    )
+    layer.put_object("ilm", "plain.txt", io.BytesIO(b"y" * 10), 10)
+    _backdate(layer, "ilm", "tagged.txt", 31)
+    _backdate(layer, "ilm", "plain.txt", 31)
+    crawler = DataCrawler(layer, meta, sleep_every=0)
+    usage = crawler.crawl_once()
+    assert usage.buckets["ilm"].objects == 1  # only plain survives
+    names = [o.name for o in layer.list_objects("ilm").objects]
+    assert names == ["plain.txt"]
 
 
 def test_crawler_suspended_versioning_keeps_history(layer):
