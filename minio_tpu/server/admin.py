@@ -10,11 +10,15 @@ the madmin-facing subset the console and mc rely on.
 from __future__ import annotations
 
 import json
+import threading
 import time
 
 from ..iam.policy import Policy, PolicyError
 from ..iam.sys import IAMError, PolicyNotFound, UserNotFound
 from .s3errors import S3Error
+
+# guards lazy creation of the per-server heal-sequence registry
+_heal_state_lock = threading.Lock()
 
 PREFIX = "/minio-tpu/admin/v1"
 VERSION = "0.3.0"
@@ -42,6 +46,18 @@ class AdminAPI:
             return 200, _json(ol.storage_info())
         if route == ("POST", "heal"):
             return 200, self._heal(ol, q)
+        # resumable heal sequences with client tokens
+        # (admin-heal-ops.go LaunchNewHealSequence/PopHealStatusJSON)
+        if route == ("POST", "heal-sequence"):
+            return 200, self._heal_sequence(ol, q)
+        if route == ("POST", "heal-sequence/stop"):
+            state = self._heal_state()
+            from ..heal.sequence import HealSequenceError
+
+            try:
+                return 200, _json(state.stop(self._heal_path(q)))
+            except HealSequenceError as e:
+                raise S3Error(e.code, str(e)) from None
         if route == ("GET", "top-locks"):
             return 200, self._top_locks()
         if route == ("GET", "cache-stats"):
@@ -354,6 +370,53 @@ class AdminAPI:
             for node_locks in notifier.all_locks():
                 locks.extend(node_locks)
         return _json({"locks": locks})
+
+    def _heal_state(self):
+        from ..heal.sequence import AllHealState
+
+        # double-checked under a module lock: two concurrent launches
+        # must share ONE registry or tokens and overlap guards split
+        with _heal_state_lock:
+            state = getattr(self.s3, "heal_state", None)
+            if state is None:
+                state = self.s3.heal_state = AllHealState()
+        return state
+
+    @staticmethod
+    def _heal_path(q: "dict[str, str]") -> str:
+        bucket = q.get("bucket", "")
+        if not bucket:
+            raise S3Error("InvalidArgument", "heal requires bucket")
+        prefix = q.get("prefix", "")
+        return f"{bucket}/{prefix}".rstrip("/")
+
+    def _heal_sequence(self, ol, q: "dict[str, str]") -> bytes:
+        """Launch (no clientToken) or poll (clientToken) a heal
+        sequence; maps HealSequenceError onto admin API errors."""
+        from ..heal.sequence import (
+            AllHealState,  # noqa: F401 (doc aid)
+            HealSequence,
+            HealSequenceError,
+        )
+
+        state = self._heal_state()
+        path = self._heal_path(q)
+        token = q.get("clientToken", "")
+        try:
+            if token:
+                return _json(state.pop_status(path, token))
+            seq = HealSequence(
+                ol,
+                q.get("bucket", ""),
+                q.get("prefix", ""),
+                dry_run=q.get("dryRun") == "true",
+                client_address=q.get("clientAddress", ""),
+            )
+            return _json(
+                state.launch(seq, q.get("forceStart") == "true")
+            )
+        except HealSequenceError as e:
+            raise S3Error(e.code, str(e)) from None
 
     def _heal(self, ol, q: "dict[str, str]") -> bytes:
         bucket = q.get("bucket", "")

@@ -62,6 +62,10 @@ _E = {
     "SignatureDoesNotMatch": ("The request signature we calculated does not match the signature you provided.", H.FORBIDDEN),
     "SignatureVersionNotSupported": ("The authorization mechanism you have provided is not supported.", H.BAD_REQUEST),
     "ServerNotInitialized": ("Server not initialized, please try again.", H.SERVICE_UNAVAILABLE),
+    "HealAlreadyRunning": ("Heal is already running on the given path", H.CONFLICT),
+    "HealOverlappingPaths": ("The heal path overlaps with a running heal sequence", H.CONFLICT),
+    "HealNoSuchProcess": ("No heal sequence exists on the given path", H.NOT_FOUND),
+    "HealInvalidClientToken": ("Client token mismatch for the heal sequence", H.BAD_REQUEST),
     "OperationTimedOut": ("A timeout occurred while trying to lock a resource, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "SlowDown": ("Resource requested is unreadable, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "XAmzContentSHA256Mismatch": ("The provided 'x-amz-content-sha256' header does not match what was computed.", H.BAD_REQUEST),

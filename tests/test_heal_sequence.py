@@ -1,0 +1,194 @@
+"""Resumable heal sequences with client tokens
+(cmd/admin-heal-ops.go)."""
+
+import io
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from minio_tpu.heal.sequence import (
+    AllHealState,
+    HealSequence,
+    HealSequenceError,
+)
+from minio_tpu.iam.sys import IAMSys
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+ADMIN = "/minio-tpu/admin/v1"
+BLOCK = 4096
+
+
+def _layer(tmp_path, n=4):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(n)]
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    ol.make_bucket("healb")
+    return ol
+
+
+def _wipe_disk(tmp_path, i):
+    """Simulate a replaced drive: wipe its payload, keep the mount."""
+    root = tmp_path / f"d{i}"
+    for entry in os.listdir(root):
+        if entry == ".sys":
+            continue
+        shutil.rmtree(root / entry, ignore_errors=True)
+
+
+def _wait_ended(seq, timeout=30.0):
+    deadline = time.time() + timeout
+    while not seq.has_ended() and time.time() < deadline:
+        time.sleep(0.05)
+    assert seq.has_ended(), seq.status
+
+
+def test_sequence_walks_and_heals(tmp_path):
+    ol = _layer(tmp_path)
+    for i in range(8):
+        data = os.urandom(3000)
+        ol.put_object("healb", f"k{i}", io.BytesIO(data), len(data))
+    _wipe_disk(tmp_path, 2)
+    state = AllHealState()
+    seq = HealSequence(ol, "healb")
+    doc = state.launch(seq)
+    token = doc["client_token"]
+    _wait_ended(seq)
+    status = state.pop_status("healb", token)
+    assert status["status"] == "finished"
+    assert status["scanned"] == 8
+    assert status["healed"] == 8  # every object lost a shard
+    objs = [i for i in status["items"] if i["type"] == "object"]
+    assert len(objs) == 8
+    # the wiped disk is back in every object's quorum
+    for i in range(8):
+        assert ol.heal_object("healb", f"k{i}", dry_run=True)[
+            "outdated"
+        ] == []
+    # second poll returns no duplicate items
+    assert state.pop_status("healb", token)["items"] == []
+
+
+def test_sequence_dry_run_reports_without_healing(tmp_path):
+    ol = _layer(tmp_path)
+    ol.put_object("healb", "k", io.BytesIO(b"x" * 3000), 3000)
+    _wipe_disk(tmp_path, 1)
+    state = AllHealState()
+    seq = HealSequence(ol, "healb", dry_run=True)
+    token = state.launch(seq)["client_token"]
+    _wait_ended(seq)
+    st = state.pop_status("healb", token)
+    assert st["healed"] == 1  # reported...
+    assert ol.heal_object("healb", "k", dry_run=True)["outdated"]  # ...not fixed
+
+
+def test_sequence_token_and_conflict_semantics(tmp_path):
+    ol = _layer(tmp_path)
+    for i in range(3):
+        ol.put_object("healb", f"p/k{i}", io.BytesIO(b"d" * 2000), 2000)
+    state = AllHealState()
+
+    # slow the walk so the sequence is still running for the checks
+    orig = ol.heal_object
+
+    def slow(*a, **k):
+        time.sleep(0.2)
+        return orig(*a, **k)
+
+    ol.heal_object = slow
+    seq = HealSequence(ol, "healb", "p/")
+    token = state.launch(seq)["client_token"]
+    # same path again: already running
+    with pytest.raises(HealSequenceError) as ei:
+        state.launch(HealSequence(ol, "healb", "p/"))
+    assert ei.value.code == "HealAlreadyRunning"
+    # overlapping parent path
+    with pytest.raises(HealSequenceError) as ei:
+        state.launch(HealSequence(ol, "healb"))
+    assert ei.value.code == "HealOverlappingPaths"
+    # wrong token
+    with pytest.raises(HealSequenceError) as ei:
+        state.pop_status("healb/p", "bogus")
+    assert ei.value.code == "HealInvalidClientToken"
+    # stop + force restart
+    state.stop("healb/p")
+    _wait_ended(seq)
+    assert seq.status in ("stopped", "finished")
+    seq2 = HealSequence(ol, "healb", "p/")
+    token2 = state.launch(seq2, force_start=True)["client_token"]
+    assert token2 != token
+    _wait_ended(seq2)
+    assert state.pop_status("healb/p", token2)["status"] == "finished"
+
+
+def test_admin_heal_sequence_e2e(tmp_path):
+    ol = _layer(tmp_path)
+    for i in range(5):
+        ol.put_object("healb", f"o{i}", io.BytesIO(b"z" * 2500), 2500)
+    _wipe_disk(tmp_path, 3)
+    iam = IAMSys("minioadmin", "minioadmin", ol)
+    srv = S3Server(ol, address="127.0.0.1:0", iam=iam).start()
+    try:
+        c = S3Client(srv.endpoint)
+        r = c.request(
+            "POST", f"{ADMIN}/heal-sequence", query={"bucket": "healb"}
+        )
+        assert r.status == 200, r.body
+        token = json.loads(r.body)["client_token"]
+        # poll until finished, accumulating items across polls
+        items = []
+        for _ in range(100):
+            r = c.request(
+                "POST", f"{ADMIN}/heal-sequence",
+                query={"bucket": "healb", "clientToken": token},
+            )
+            assert r.status == 200, r.body
+            doc = json.loads(r.body)
+            items.extend(doc["items"])
+            if doc["status"] != "running":
+                break
+            time.sleep(0.1)
+        assert doc["status"] == "finished"
+        assert doc["scanned"] == 5 and doc["healed"] == 5
+        assert sum(1 for i in items if i["type"] == "object") == 5
+        # bad token -> 400
+        r = c.request(
+            "POST", f"{ADMIN}/heal-sequence",
+            query={"bucket": "healb", "clientToken": "nope"},
+        )
+        assert r.status == 400
+        assert r.error_code == "HealInvalidClientToken"
+        # no sequence on an unknown path -> 404
+        r = c.request(
+            "POST", f"{ADMIN}/heal-sequence",
+            query={"bucket": "healb", "prefix": "zz/", "clientToken": "x"},
+        )
+        assert r.status == 404
+    finally:
+        srv.shutdown()
+
+
+def test_sibling_paths_do_not_overlap(tmp_path):
+    ol = _layer(tmp_path)
+    ol.make_bucket("healb2")
+    ol.put_object("healb", "k", io.BytesIO(b"x" * 2000), 2000)
+    ol.put_object("healb2", "k", io.BytesIO(b"y" * 2000), 2000)
+    state = AllHealState()
+    orig = ol.heal_object
+
+    def slow(*a, **k):
+        time.sleep(0.3)
+        return orig(*a, **k)
+
+    ol.heal_object = slow
+    t1 = state.launch(HealSequence(ol, "healb"))["client_token"]
+    # sibling bucket with a shared name prefix: NOT an overlap
+    seq2 = HealSequence(ol, "healb2")
+    t2 = state.launch(seq2)["client_token"]
+    assert t1 != t2
+    _wait_ended(seq2)
