@@ -256,8 +256,15 @@ def get_kms() -> "KMS | None":
             )
         else:
             raw = os.environ.get("MINIO_TPU_KMS_MASTER_KEY", "")
-            if raw and ":" in raw:
-                key_id, _, hexkey = raw.partition(":")
+            if raw:
+                key_id, sep, hexkey = raw.partition(":")
+                if not sep or not key_id:
+                    # a SET but malformed key is a config error, not
+                    # "no KMS" - silence here would fail every SSE-S3
+                    # write with a misleading 'not configured'
+                    raise KMSError(
+                        "MINIO_TPU_KMS_MASTER_KEY must be <id>:<hex>"
+                    )
                 try:
                     mk = bytes.fromhex(hexkey)
                 except ValueError:

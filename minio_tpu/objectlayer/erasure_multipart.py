@@ -148,11 +148,12 @@ class MultipartMixin:
         # the plaintext MD5 the client computed
         compress = bool(mfi.metadata.get(compmod.META_COMPRESSION))
         src = compmod.CompressReader(hreader) if compress else hreader
-        if sse is not None and not mfi.metadata.get(ssemod.META_SSE):
-            # a key on a part of an UNENCRYPTED upload must fail, not
-            # be silently dropped onto plaintext storage
+        if sse is not None and mfi.metadata.get(ssemod.META_SSE) != "C":
+            # a customer key on a part of an unencrypted OR SSE-S3
+            # upload must fail, not be silently dropped (AWS rejects
+            # the mode mismatch)
             raise ssemod.SSEError(
-                "upload was not initiated with server-side encryption"
+                "upload was not initiated with customer-key encryption"
             )
         if mfi.metadata.get(ssemod.META_SSE):
             bkt = mfi.metadata.get("x-internal-bucket", bucket)

@@ -312,6 +312,10 @@ class FSObjects(ObjectLayer):
         self, src_bucket, src_object, dst_bucket, dst_object,
         metadata=None, versioned=False, sse_src=None, sse=None,
     ) -> ObjectInfo:
+        if sse is not None or sse_src is not None:
+            # silently dropping an encryption demand would store
+            # plaintext behind a 200
+            raise NotImplementedError("SSE on the FS backend")
         src_info = self.get_object_info(src_bucket, src_object)
         meta = prepare_copy_meta(src_info, metadata)
         compmod.strip_internal_meta(meta)

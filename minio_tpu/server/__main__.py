@@ -229,6 +229,62 @@ def build_lock_plane(
     )
 
 
+def run_gateway(args) -> int:
+    """Serve the S3 API over a non-erasure backend
+    (cmd/gateway/gateway-main.go).  No storage/lock planes, no heal,
+    no crawler - the backend owns durability."""
+    from .http import S3Server
+
+    if len(args.zones) != 3:
+        raise SystemExit(
+            "usage: server gateway {nas <path> | s3 <endpoint-url>}"
+        )
+    kind, target = args.zones[1], args.zones[2]
+    if kind == "nas":
+        from ..objectlayer.fs import FSObjects
+
+        ol = FSObjects(target)
+        desc = f"NAS gateway over {target}"
+    elif kind == "s3":
+        from ..gateway.s3 import S3Objects
+
+        ol = S3Objects(
+            target,
+            os.environ.get("MINIO_TPU_GATEWAY_ACCESS_KEY")
+            or args.access_key,
+            os.environ.get("MINIO_TPU_GATEWAY_SECRET_KEY")
+            or args.secret_key,
+            region=args.region,
+        )
+        desc = f"S3 gateway to {target}"
+    else:
+        raise SystemExit(f"unknown gateway backend {kind!r}")
+    srv = S3Server(
+        ol,
+        address=args.address,
+        access_key=args.access_key,
+        secret_key=args.secret_key,
+        region=args.region,
+    )
+    from ..iam.sys import IAMSys
+
+    # IAM rides the backend for nas (persistent), memory for s3 (the
+    # upstream bucket namespace is not ours to write into)
+    iam = IAMSys(
+        args.access_key,
+        args.secret_key,
+        ol if kind == "nas" else None,
+    )
+    srv.attach_iam(iam)
+    srv.start()
+    print(f"minio-tpu serving {desc} at {srv.endpoint}")
+    sys.stdout.flush()
+    stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
+    print(f"signal {stop}, shutting down")
+    srv.shutdown()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="minio-tpu server")
     p.add_argument(
@@ -262,6 +318,11 @@ def main(argv=None) -> int:
     from ..utils import log
 
     log.setup(os.environ.get("MINIO_TPU_LOG_LEVEL", "info"))
+
+    # gateway mode (cmd/gateway/): `server gateway nas /path` or
+    # `server gateway s3 http://upstream:9000`
+    if args.zones and args.zones[0] == "gateway":
+        return run_gateway(args)
 
     from ..cluster.endpoints import resolve_endpoints
     from ..storage.rest_server import StorageRESTServer
