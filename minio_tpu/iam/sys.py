@@ -227,6 +227,14 @@ class IAMSys:
     def is_owner(self, access_key: str) -> bool:
         return access_key == self.root_access_key
 
+    def is_temp_credential(self, access_key: str) -> bool:
+        """Whether the key is an STS temporary credential (those are
+        refused console login: their session rides the S3 plane with
+        its own token, web-handlers.go authenticateWeb)."""
+        with self._mu:
+            u = self._users.get(access_key)
+        return bool(u and u.get("sts"))
+
     # -- user management (iam.go SetUser/DeleteUser/...) ------------------
 
     def add_user(

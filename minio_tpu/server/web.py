@@ -1,0 +1,385 @@
+"""Web UI backend: the browser's JSON-RPC control plane plus
+upload/download endpoints (cmd/web-handlers.go:81, web-router.go).
+
+Wire shape matches the reference's jsonrpc usage::
+
+    POST /minio-tpu/webrpc
+    {"id": 1, "jsonrpc": "2.0", "method": "web.ListBuckets",
+     "params": {}}
+
+``web.Login`` exchanges credentials for a JWT (signed with the
+server's root secret, like the reference's authenticateWeb); every
+other method requires it as a Bearer token.  File transfer rides
+dedicated endpoints so bodies stream instead of riding JSON:
+
+    PUT /minio-tpu/web/upload/<bucket>/<object>     (Bearer token)
+    GET /minio-tpu/web/download/<bucket>/<object>?token=<url token>
+
+The browser frontend itself (static assets) is not bundled - any
+S3-browser UI can drive this plane.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+
+from ..utils import jwt
+from . import s3errors
+from .s3errors import S3Error
+
+RPC_PATH = "/minio-tpu/webrpc"
+WEB_PREFIX = "/minio-tpu/web"
+TOKEN_EXPIRY_S = 24 * 3600
+URL_TOKEN_EXPIRY_S = 3600
+UI_VERSION = "minio-tpu-web/1"
+
+
+class WebError(Exception):
+    pass
+
+
+def _auth_token(h) -> str:
+    """Validated access key from the request's Bearer token."""
+    authz = h.headers.get("Authorization", "")
+    if not authz.startswith("Bearer "):
+        raise WebError("authentication required")
+    try:
+        claims = jwt.verify(
+            authz[len("Bearer "):], h.s3.iam.root_secret_key
+        )
+    except jwt.JWTError as e:
+        raise WebError(f"invalid token: {e}") from None
+    return claims.get("sub", "")
+
+
+def _allow(h, access_key: str, action: str, bucket: str,
+           key: str = "") -> None:
+    """One IAM/policy decision for a web call - the same authorize()
+    the S3 plane runs (a read-only user must be read-only here too)."""
+    h._query = {}
+    if not h._check_action(action, bucket, key, access_key):
+        raise WebError("access denied")
+
+
+# -- RPC methods ------------------------------------------------------------
+
+
+def _login(h, params) -> dict:
+    import hmac as hmac_mod
+
+    username = params.get("username", "")
+    password = params.get("password", "")
+    secret = h.s3.iam.lookup_secret(username)
+    if secret is None or not hmac_mod.compare_digest(
+        secret, password
+    ):
+        raise WebError("invalid credentials")
+    if h.s3.iam.is_temp_credential(username):
+        # a 24h web JWT must not outlive a short-lived STS credential
+        raise WebError(
+            "temporary credentials cannot log into the web console"
+        )
+    token = jwt.sign(
+        {"sub": username}, h.s3.iam.root_secret_key, expiry_s=TOKEN_EXPIRY_S
+    )
+    return {"token": token, "uiVersion": UI_VERSION}
+
+
+def _server_info(h, params, access_key) -> dict:
+    import time
+
+    return {
+        "MinioVersion": UI_VERSION,
+        "MinioMemory": "",
+        "MinioPlatform": "",
+        "MinioRuntime": "python",
+        "MinioGlobalInfo": {
+            "isDistErasure": h.s3.peer_notifier is not None,
+            "serverTime_ns": time.time_ns(),
+        },
+        "MinioUserInfo": {"isIAMUser": False},
+    }
+
+
+def _storage_info(h, params, access_key) -> dict:
+    return h.s3.object_layer.storage_info()
+
+
+def _list_buckets(h, params, access_key) -> dict:
+    out = []
+    for b in h.s3.object_layer.list_buckets():
+        if b.name.startswith("."):
+            continue
+        # per-bucket visibility, like the reference's web ListBuckets
+        # (readable buckets only)
+        try:
+            _allow(h, access_key, "s3:ListBucket", b.name)
+        except WebError:
+            continue
+        out.append(
+            {"name": b.name, "creationDate_ns": b.created_ns}
+        )
+    return {"buckets": out}
+
+
+def _make_bucket(h, params, access_key) -> dict:
+    bucket = params.get("bucketName", "")
+    _allow(h, access_key, "s3:CreateBucket", bucket)
+    # the shared path keeps web creates federation-unique
+    h._bucket_create(bucket)
+    return {}
+
+
+def _delete_bucket(h, params, access_key) -> dict:
+    bucket = params.get("bucketName", "")
+    _allow(h, access_key, "s3:DeleteBucket", bucket)
+    # the shared path unregisters DNS + drops config/event rules
+    h._bucket_delete(bucket)
+    return {}
+
+
+def _list_objects(h, params, access_key) -> dict:
+    _allow(h, access_key, "s3:ListBucket", params.get("bucketName", ""))
+    res = h.s3.object_layer.list_objects(
+        params.get("bucketName", ""),
+        params.get("prefix", ""),
+        params.get("marker", ""),
+        "/",
+        int(params.get("maxKeys", 1000)),
+    )
+    return {
+        "objects": [
+            {
+                "name": o.name,
+                "size": o.size,
+                "lastModified_ns": o.mod_time_ns,
+                "contentType": o.content_type,
+                "etag": o.etag,
+            }
+            for o in res.objects
+        ]
+        + [{"name": p, "size": 0, "isDir": True} for p in res.prefixes],
+        "isTruncated": res.is_truncated,
+        "nextMarker": res.next_marker,
+    }
+
+
+def _remove_objects(h, params, access_key) -> dict:
+    bucket = params.get("bucketName", "")
+    removed, errors = [], []
+    versioned, suspended = h._versioning(bucket)
+    for name in params.get("objects", []):
+        try:
+            _allow(h, access_key, "s3:DeleteObject", bucket, name)
+            h.s3.object_layer.delete_object(
+                bucket, name,
+                versioned=versioned, version_suspended=suspended,
+            )
+            removed.append(name)
+        except Exception as e:  # noqa: BLE001
+            errors.append({"object": name, "error": str(e)})
+    return {"removed": removed, "errors": errors}
+
+
+def _presigned_get(h, params, access_key) -> dict:
+    from .auth import presign_url
+
+    bucket = params.get("bucketName", "")
+    obj = params.get("objectName", "")
+    expiry = min(int(params.get("expiry", 3600)), 7 * 24 * 3600)
+    _allow(h, access_key, "s3:GetObject", bucket, obj)
+    secret = h.s3.iam.lookup_secret(access_key)
+    if secret is None:
+        raise WebError("credentials no longer valid")
+    url = presign_url(
+        "GET",
+        f"{h.s3.endpoint}/{bucket}/{urllib.parse.quote(obj)}",
+        access_key,
+        secret,
+        expires=expiry,
+        region=h.s3.region,
+    )
+    return {"url": url}
+
+
+def _create_url_token(h, params, access_key) -> dict:
+    return {
+        "token": jwt.sign(
+            {"sub": access_key, "web-url-token": True},
+            h.s3.iam.root_secret_key,
+            expiry_s=URL_TOKEN_EXPIRY_S,
+        )
+    }
+
+
+def _get_bucket_policy(h, params, access_key) -> dict:
+    bucket = params.get("bucketName", "")
+    _allow(h, access_key, "s3:GetBucketPolicy", bucket)
+    h.s3.object_layer.get_bucket_info(bucket)
+    return {
+        "policy": h.s3.bucket_meta.get(bucket).policy_json or ""
+    }
+
+
+def _set_bucket_policy(h, params, access_key) -> dict:
+    from ..iam.policy import Policy, PolicyError
+
+    bucket = params.get("bucketName", "")
+    _allow(h, access_key, "s3:PutBucketPolicy", bucket)
+    h.s3.object_layer.get_bucket_info(bucket)
+    raw = params.get("policy", "")
+    if raw:
+        try:
+            Policy.from_json(raw)
+        except (PolicyError, ValueError) as e:
+            raise WebError(f"bad policy: {e}") from None
+    h.s3.bucket_meta.update(bucket, policy_json=raw)
+    return {}
+
+
+_METHODS = {
+    "web.ServerInfo": _server_info,
+    "web.StorageInfo": _storage_info,
+    "web.ListBuckets": _list_buckets,
+    "web.MakeBucket": _make_bucket,
+    "web.DeleteBucket": _delete_bucket,
+    "web.ListObjects": _list_objects,
+    "web.RemoveObject": _remove_objects,
+    "web.GetBucketPolicy": _get_bucket_policy,
+    "web.SetBucketPolicy": _set_bucket_policy,
+    "web.PresignedGet": _presigned_get,
+    "web.CreateURLToken": _create_url_token,
+}
+
+
+def _rpc(h) -> None:
+    try:
+        doc = json.loads(h._read_body() or b"{}")
+    except ValueError:
+        return _rpc_error(h, None, "parse error")
+    rid = doc.get("id")
+    method = doc.get("method", "")
+    params = doc.get("params") or {}
+    try:
+        if method == "web.Login":
+            return _rpc_result(h, rid, _login(h, params))
+        access_key = _auth_token(h)
+        if h.s3.object_layer is None:
+            raise WebError("server initializing")
+        fn = _METHODS.get(method)
+        if fn is not None:
+            return _rpc_result(h, rid, fn(h, params, access_key))
+        return _rpc_error(h, rid, f"unknown method {method!r}")
+    except WebError as e:
+        return _rpc_error(h, rid, str(e))
+    except Exception as e:  # noqa: BLE001
+        err = s3errors.from_exception(e)
+        return _rpc_error(h, rid, f"{err.code}: {err.message}")
+
+
+def _rpc_result(h, rid, result) -> None:
+    h._respond(
+        200,
+        json.dumps(
+            {"jsonrpc": "2.0", "id": rid, "result": result}
+        ).encode(),
+        content_type="application/json",
+    )
+
+
+def _rpc_error(h, rid, message: str) -> None:
+    h._respond(
+        200,  # jsonrpc transports errors in-band
+        json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": rid,
+                "error": {"message": message},
+            }
+        ).encode(),
+        content_type="application/json",
+    )
+
+
+# -- upload / download ------------------------------------------------------
+
+
+def _upload(h, bucket: str, obj: str) -> None:
+    access_key = _auth_token(h)  # bearer-authenticated like WebUpload
+    try:
+        _allow(h, access_key, "s3:PutObject", bucket, obj)
+    except WebError:
+        raise S3Error("AccessDenied") from None
+    reader, size = h._open_body()
+    if size < 0:
+        raise S3Error("MissingContentLength")
+    from ..utils.hashreader import HashReader
+
+    versioned, _ = h._versioning(bucket)
+    info = h.s3.object_layer.put_object(
+        bucket,
+        obj,
+        HashReader(reader, size),
+        size,
+        {
+            "content-type": h.headers.get("Content-Type")
+            or "application/octet-stream"
+        },
+        versioned=versioned,
+    )
+    h._respond(200, b"", {"ETag": f'"{info.etag}"'})
+
+
+def _download(h, bucket: str, obj: str, query) -> None:
+    token = query.get("token", [""])[0]
+    try:
+        claims = jwt.verify(token, h.s3.iam.root_secret_key)
+    except jwt.JWTError as e:
+        raise S3Error("AccessDenied", f"bad token: {e}") from None
+    if not claims.get("web-url-token"):
+        raise S3Error("AccessDenied", "not a download token")
+    try:
+        _allow(h, claims.get("sub", ""), "s3:GetObject", bucket, obj)
+    except WebError:
+        raise S3Error("AccessDenied") from None
+    info = h.s3.object_layer.get_object_info(bucket, obj)
+    h.send_response(200)
+    h.send_header("Server", "MinIO-TPU")
+    h.send_header("Content-Type", "application/octet-stream")
+    # control chars and quotes stripped: a crafted object name must
+    # not split the response into injected headers
+    fname = "".join(
+        c
+        for c in obj.rsplit("/", 1)[-1]
+        if c.isprintable() and c not in '"\\'
+    ) or "download"
+    h.send_header(
+        "Content-Disposition", f'attachment; filename="{fname}"'
+    )
+    h.send_header("Content-Length", str(info.size))
+    h.end_headers()
+    h._headers_sent = True
+    h._last_status = 200
+    if info.size:
+        h.s3.object_layer.get_object(bucket, obj, h.wfile)
+        h._resp_bytes += info.size
+
+
+def handle(h, path: str, query) -> None:
+    """Entry from the router for RPC_PATH / WEB_PREFIX paths."""
+    if path == RPC_PATH:
+        if h.command != "POST":
+            raise S3Error("MethodNotAllowed")
+        return _rpc(h)
+    tail = path[len(WEB_PREFIX) + 1 :]
+    parts = tail.split("/", 2)
+    if len(parts) == 3 and parts[0] == "upload" and h.command == "PUT":
+        return _upload(
+            h, parts[1], urllib.parse.unquote(parts[2])
+        )
+    if len(parts) == 3 and parts[0] == "download" and h.command == "GET":
+        return _download(
+            h, parts[1], urllib.parse.unquote(parts[2]), query
+        )
+    raise S3Error("MethodNotAllowed")

@@ -1,0 +1,274 @@
+"""Web UI backend: JSON-RPC plane + upload/download endpoints
+(cmd/web-handlers.go)."""
+
+import http.client
+import json
+import urllib.parse
+
+import pytest
+
+from minio_tpu.iam.sys import IAMSys
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+RPC = "/minio-tpu/webrpc"
+
+
+@pytest.fixture()
+def server(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
+    iam = IAMSys("minioadmin", "minioadmin", ol)
+    srv = S3Server(ol, address="127.0.0.1:0", iam=iam).start()
+    yield srv
+    srv.shutdown()
+
+
+def _raw(server, method, path, body=b"", headers=None):
+    host, port = server.endpoint.split("//")[1].rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        r = conn.getresponse()
+        return r.status, dict(r.getheaders()), r.read()
+    finally:
+        conn.close()
+
+
+def _rpc(server, method, params=None, token=None):
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    st, _h, body = _raw(
+        server, "POST", RPC,
+        json.dumps(
+            {"id": 1, "jsonrpc": "2.0", "method": method,
+             "params": params or {}}
+        ).encode(),
+        headers,
+    )
+    assert st == 200, body
+    return json.loads(body)
+
+
+def _login(server):
+    doc = _rpc(
+        server, "web.Login",
+        {"username": "minioadmin", "password": "minioadmin"},
+    )
+    assert "result" in doc, doc
+    return doc["result"]["token"]
+
+
+def test_login_and_bad_credentials(server):
+    token = _login(server)
+    assert token
+    doc = _rpc(
+        server, "web.Login",
+        {"username": "minioadmin", "password": "wrong"},
+    )
+    assert "error" in doc
+    # unauthenticated calls are refused
+    doc = _rpc(server, "web.ListBuckets")
+    assert "error" in doc and "authentication" in doc["error"]["message"]
+    # garbage token refused
+    doc = _rpc(server, "web.ListBuckets", token="junk")
+    assert "error" in doc
+
+
+def test_bucket_and_object_rpc_flow(server):
+    token = _login(server)
+    assert "result" in _rpc(
+        server, "web.MakeBucket", {"bucketName": "webbkt"}, token
+    )
+    buckets = _rpc(server, "web.ListBuckets", {}, token)["result"][
+        "buckets"
+    ]
+    assert [b["name"] for b in buckets] == ["webbkt"]
+
+    # upload over the streaming endpoint
+    st, h, _b = _raw(
+        server, "PUT", "/minio-tpu/web/upload/webbkt/dir/f.txt",
+        b"web-upload-bytes",
+        {
+            "Authorization": f"Bearer {token}",
+            "Content-Type": "text/plain",
+            "Content-Length": "16",
+        },
+    )
+    assert st == 200, _b
+    listing = _rpc(
+        server, "web.ListObjects",
+        {"bucketName": "webbkt", "prefix": "dir/"}, token,
+    )["result"]
+    assert [o["name"] for o in listing["objects"]] == ["dir/f.txt"]
+    assert listing["objects"][0]["size"] == 16
+
+    # download via a URL token (link sharing)
+    url_token = _rpc(
+        server, "web.CreateURLToken", {}, token
+    )["result"]["token"]
+    st, h, body = _raw(
+        server, "GET",
+        "/minio-tpu/web/download/webbkt/dir/f.txt?"
+        + urllib.parse.urlencode({"token": url_token}),
+    )
+    assert st == 200 and body == b"web-upload-bytes"
+    assert "attachment" in h.get("Content-Disposition", "")
+    # a login token is NOT a download token
+    st, _h, _b = _raw(
+        server, "GET",
+        "/minio-tpu/web/download/webbkt/dir/f.txt?"
+        + urllib.parse.urlencode({"token": token}),
+    )
+    assert st == 403
+
+    # presigned GET serves anonymously with the signature
+    url = _rpc(
+        server, "web.PresignedGet",
+        {"bucketName": "webbkt", "objectName": "dir/f.txt"}, token,
+    )["result"]["url"]
+    parsed = urllib.parse.urlsplit(url)
+    st, _h, body = _raw(
+        server, "GET", f"{parsed.path}?{parsed.query}"
+    )
+    assert st == 200 and body == b"web-upload-bytes", body
+
+    # remove + delete bucket
+    res = _rpc(
+        server, "web.RemoveObject",
+        {"bucketName": "webbkt", "objects": ["dir/f.txt"]}, token,
+    )["result"]
+    assert res["removed"] == ["dir/f.txt"] and not res["errors"]
+    assert "result" in _rpc(
+        server, "web.DeleteBucket", {"bucketName": "webbkt"}, token
+    )
+
+
+def test_policy_rpc_and_info(server):
+    token = _login(server)
+    _rpc(server, "web.MakeBucket", {"bucketName": "polbkt"}, token)
+    policy = json.dumps(
+        {
+            "Version": "2012-10-17",
+            "Statement": [
+                {
+                    "Effect": "Allow",
+                    "Principal": "*",
+                    "Action": "s3:GetObject",
+                    "Resource": "arn:aws:s3:::polbkt/*",
+                }
+            ],
+        }
+    )
+    assert "result" in _rpc(
+        server, "web.SetBucketPolicy",
+        {"bucketName": "polbkt", "policy": policy}, token,
+    )
+    got = _rpc(
+        server, "web.GetBucketPolicy", {"bucketName": "polbkt"}, token
+    )["result"]["policy"]
+    assert json.loads(got) == json.loads(policy)
+    # malformed policy rejected
+    assert "error" in _rpc(
+        server, "web.SetBucketPolicy",
+        {"bucketName": "polbkt", "policy": "{bad"}, token,
+    )
+    info = _rpc(server, "web.ServerInfo", {}, token)["result"]
+    assert info["MinioRuntime"] == "python"
+    storage = _rpc(server, "web.StorageInfo", {}, token)["result"]
+    assert storage["disks"] == 4
+
+
+def test_iam_user_can_login(server):
+    server.iam.add_user("webuser", "webuser-secret-123", "readwrite")
+    doc = _rpc(
+        server, "web.Login",
+        {"username": "webuser", "password": "webuser-secret-123"},
+    )
+    assert "result" in doc, doc
+    token = doc["result"]["token"]
+    assert "result" in _rpc(server, "web.ListBuckets", {}, token)
+
+
+def test_readonly_user_cannot_mutate(server):
+    """Web calls run the same policy engine as the S3 plane
+    (review r4): a read-only user must stay read-only."""
+    server.iam.add_user("rouser", "rouser-secret-123", "readonly")
+    doc = _rpc(
+        server, "web.Login",
+        {"username": "rouser", "password": "rouser-secret-123"},
+    )
+    token = doc["result"]["token"]
+    assert "error" in _rpc(
+        server, "web.MakeBucket", {"bucketName": "robkt"}, token
+    )
+    root = _login(server)
+    _rpc(server, "web.MakeBucket", {"bucketName": "robkt"}, root)
+    # reads the canned readonly policy grants (GetObject) work
+    assert "result" in _rpc(
+        server, "web.PresignedGet",
+        {"bucketName": "robkt", "objectName": "x"}, token,
+    )
+    # listing is NOT in the canned readonly policy - denied here
+    # exactly like on the S3 plane
+    assert "error" in _rpc(
+        server, "web.ListObjects", {"bucketName": "robkt"}, token
+    )
+    # mutations denied
+    assert "error" in _rpc(
+        server, "web.DeleteBucket", {"bucketName": "robkt"}, token
+    )
+    res = _rpc(
+        server, "web.RemoveObject",
+        {"bucketName": "robkt", "objects": ["x"]}, token,
+    )["result"]
+    assert res["errors"] and not res["removed"]
+    st, _h, _b = _raw(
+        server, "PUT", "/minio-tpu/web/upload/robkt/f",
+        b"nope",
+        {"Authorization": f"Bearer {token}", "Content-Length": "4"},
+    )
+    assert st == 403
+
+
+def test_sts_credentials_cannot_login(server):
+    creds = server.iam.assume_role("minioadmin", duration_s=900)
+    doc = _rpc(
+        server, "web.Login",
+        {
+            "username": creds["access_key"],
+            "password": creds["secret"],
+        },
+    )
+    assert "error" in doc
+    assert "temporary" in doc["error"]["message"]
+
+
+def test_download_filename_sanitized(server, tmp_path):
+    token = _login(server)
+    _rpc(server, "web.MakeBucket", {"bucketName": "injbkt"}, token)
+    evil = 'f\r\nSet-Cookie: x=1;.txt'
+    import urllib.parse as up
+
+    st, _h, _b = _raw(
+        server, "PUT",
+        "/minio-tpu/web/upload/injbkt/" + up.quote(evil),
+        b"data",
+        {"Authorization": f"Bearer {token}", "Content-Length": "4"},
+    )
+    assert st == 200
+    url_token = _rpc(server, "web.CreateURLToken", {}, token)[
+        "result"
+    ]["token"]
+    st, h, body = _raw(
+        server, "GET",
+        "/minio-tpu/web/download/injbkt/" + up.quote(evil)
+        + "?" + up.urlencode({"token": url_token}),
+    )
+    assert st == 200 and body == b"data"
+    assert "Set-Cookie" not in h
+    assert "\r" not in h.get("Content-Disposition", "")
