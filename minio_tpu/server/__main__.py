@@ -412,6 +412,32 @@ def main(argv=None) -> int:
     if ol_front is not ol:
         print("disk cache enabled")
     srv.object_layer = ol_front
+    # federation: a shared record dir plays etcd's role for bucket
+    # DNS (cmd/config/etcd/dns); every cluster pointing at the same
+    # dir shares one global bucket namespace
+    fed_dir = os.environ.get("MINIO_TPU_FEDERATION_DIR", "")
+    if fed_dir:
+        from ..cluster.dns import BucketDNS, FileDNSStore
+
+        adv_host = (
+            os.environ.get("MINIO_TPU_FEDERATION_HOST")
+            or args.address.rsplit(":", 1)[0]
+        )
+        if adv_host in ("0.0.0.0", ""):
+            adv_host = "127.0.0.1"
+        srv.bucket_dns = BucketDNS(
+            FileDNSStore(fed_dir),
+            adv_host,
+            local_port,
+            scheme=(
+                "https"
+                if (os.environ.get("MINIO_TPU_TLS") or "").lower()
+                in ("1", "on", "true")
+                else "http"
+            ),
+        )
+        print(f"federation: bucket DNS at {fed_dir} as "
+              f"{adv_host}:{local_port}")
     # once formats are known, the storage REST plane serves the
     # DiskIDCheck-wrapped disks too: peer I/O must not write onto a
     # swapped drive either (xl-storage-disk-id-check.go applies to the

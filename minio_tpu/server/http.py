@@ -161,6 +161,9 @@ class S3Server:
         )
         self.heal_routine = None  # attached by the server main
         self.heal_queue = None
+        # federation bucket DNS (cluster/dns.BucketDNS); None when
+        # this deployment is not federated
+        self.bucket_dns = None
         # peer control plane (distributed mode): PeerNotifier fanning
         # out cache invalidations + aggregating node info
         self.peer_notifier = None
@@ -586,7 +589,24 @@ class _Handler(BaseHTTPRequestHandler):
             return
         t0 = _time.monotonic()
         try:
-            self._route_authed(path, query)
+            from . import web as webmod
+
+            if path == webmod.RPC_PATH or path.startswith(
+                webmod.WEB_PREFIX + "/"
+            ):
+                # web plane: JWT-authenticated (not SigV4), its own
+                # error envelope (web-router.go)
+                self._action = "Web"
+                try:
+                    webmod.handle(self, path, query)
+                except Exception as e:  # noqa: BLE001
+                    if not self._headers_sent:
+                        self._error(s3errors.from_exception(e), path)
+                    else:
+                        self.close_connection = True
+                self._finish_body()
+            else:
+                self._route_authed(path, query)
         finally:
             self.s3.release()
             # collectAPIStats analogue: every authed-path request lands
@@ -1000,6 +1020,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._list_buckets()
             raise S3Error("MethodNotAllowed")
 
+        if self.s3.bucket_dns is not None and self._federated_redirect(
+            bucket, key, m, query
+        ):
+            return
+
         if key:
             if m == "GET":
                 if "uploadId" in query:
@@ -1188,11 +1213,7 @@ class _Handler(BaseHTTPRequestHandler):
             if "replication" in query:
                 return self._delete_bucket_replication(bucket)
             self._reject_subresources(query, self._BUCKET_SUBRESOURCES)
-            ol.delete_bucket(bucket)
-            self.s3.bucket_meta.delete(bucket)
-            # a recreated bucket must not inherit the old rules
-            self.s3.events.remove_bucket(bucket)
-            self.s3.invalidate_event_rules(bucket)
+            self._bucket_delete(bucket)
             return self._respond(204)
         if m == "POST":
             if "delete" in query:
@@ -1205,6 +1226,95 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._post_policy(bucket)
         raise S3Error("MethodNotAllowed")
 
+    def _federated_redirect(self, bucket, key, m, query) -> bool:
+        """Federation: requests for a bucket owned by ANOTHER cluster
+        are answered 307 to its endpoint.  DELIBERATE DIVERGENCE from
+        the reference, which relies on external DNS routing
+        (bucket.domain) and only proxies the web plane - a redirect
+        keeps path-style clients working without CoreDNS.  Returns
+        True when the response was written."""
+        from ..cluster.dns import DNSError, NoEntriesFound
+        from ..objectlayer.api import BucketNotFound
+
+        if not key and m == "PUT" and not query:
+            return False  # bucket creation negotiates ownership itself
+        try:
+            self.s3.object_layer.get_bucket_info(bucket)
+            return False  # ours: serve locally
+        except BucketNotFound:
+            pass
+        except Exception:  # noqa: BLE001
+            return False
+        try:
+            recs = self.s3.bucket_dns.lookup(bucket)
+        except (NoEntriesFound, DNSError):
+            return False  # genuinely absent: the normal 404 path
+        if self.s3.bucket_dns.owned_by_us(recs):
+            return False
+        r = recs[0]
+        # the OWNER's scheme rides the record - the local listener's
+        # TLS mode says nothing about the remote cluster's
+        self._respond(
+            307,
+            headers={
+                "Location": f"{r.scheme}://{r.host}:{r.port}{self.path}"
+            },
+        )
+        return True
+
+    def _bucket_create(self, bucket: str) -> None:
+        """Bucket creation incl. federation negotiation - ONE
+        implementation for the S3 and web planes (a web create must
+        be just as globally unique as an S3 one)."""
+        dns = self.s3.bucket_dns
+        if dns is not None:
+            from ..cluster.dns import NoEntriesFound
+
+            try:
+                recs = dns.lookup(bucket)
+            except NoEntriesFound:
+                recs = None
+            if recs is not None:
+                # bucket names are globally unique across the
+                # federation (bucket-handlers.go:601-609)
+                raise S3Error(
+                    "BucketAlreadyOwnedByYou"
+                    if dns.owned_by_us(recs)
+                    else "BucketAlreadyExists"
+                )
+        self.s3.object_layer.make_bucket(bucket)
+        if dns is not None:
+            from ..cluster.dns import RecordExists
+
+            try:
+                dns.register(bucket)
+            except RecordExists:
+                # lost the exclusive-create race to another cluster:
+                # the bucket must not exist half-federated
+                # (MakeBucket rollback, bucket-handlers.go:572)
+                self.s3.object_layer.delete_bucket(bucket, force=True)
+                raise S3Error("BucketAlreadyExists") from None
+            except Exception:  # noqa: BLE001
+                self.s3.object_layer.delete_bucket(bucket, force=True)
+                raise S3Error(
+                    "InternalError", "failed to register bucket in DNS"
+                ) from None
+
+    def _bucket_delete(self, bucket: str) -> None:
+        """Bucket deletion incl. DNS unregistration and config/event
+        cleanup - shared by the S3 and web planes."""
+        self.s3.object_layer.delete_bucket(bucket)
+        if self.s3.bucket_dns is not None:
+            try:
+                self.s3.bucket_dns.unregister(bucket)
+            except Exception:  # noqa: BLE001
+                pass  # stale record; the next create collides and
+                # the operator clears it (reference logs the same)
+        self.s3.bucket_meta.delete(bucket)
+        # a recreated bucket must not inherit the old rules
+        self.s3.events.remove_bucket(bucket)
+        self.s3.invalidate_event_rules(bucket)
+
     def _make_bucket(self, bucket: str):
         """CreateBucket, honoring x-amz-bucket-object-lock-enabled
         (bucket-handlers.go:528): lock-enabled buckets are born
@@ -1216,7 +1326,7 @@ class _Handler(BaseHTTPRequestHandler):
         ).lower()
         if lock_hdr and lock_hdr not in ("true", "false"):
             raise S3Error("InvalidRequest")
-        self.s3.object_layer.make_bucket(bucket)
+        self._bucket_create(bucket)
         if lock_hdr == "true":
             self.s3.bucket_meta.update(
                 bucket,
@@ -1229,6 +1339,28 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _list_buckets(self):
         buckets = self.s3.object_layer.list_buckets()
+        if self.s3.bucket_dns is not None:
+            # federated view: every cluster's buckets, deduped
+            # (bucket-handlers.go:74 dnsBuckets merge)
+            from ..objectlayer.api import BucketInfo
+
+            have = {b.name for b in buckets}
+            try:
+                federated = self.s3.bucket_dns.federated_buckets()
+            except Exception:  # noqa: BLE001
+                federated = {}
+            for name, recs in sorted(federated.items()):
+                if name not in have:
+                    buckets.append(
+                        BucketInfo(
+                            name=name,
+                            created_ns=min(
+                                (r.creation_ns for r in recs),
+                                default=0,
+                            ),
+                        )
+                    )
+            buckets.sort(key=lambda b: b.name)
         self._respond(200, xmlr.list_buckets_xml(buckets))
 
     # -- bucket ops -------------------------------------------------------

@@ -1,0 +1,206 @@
+"""Federation: bucket DNS store + cross-cluster routing
+(cmd/config/etcd/dns, bucket-handlers.go federation paths)."""
+
+import io
+
+import pytest
+
+from minio_tpu.cluster.dns import (
+    BucketDNS,
+    FileDNSStore,
+    MemoryDNSStore,
+    NoEntriesFound,
+    SrvRecord,
+)
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+BLOCK = 4096
+
+
+# ---------------------------------------------------------------------------
+# stores
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mk", [MemoryDNSStore, None])
+def test_dns_store_crud(mk, tmp_path):
+    store = mk() if mk else FileDNSStore(str(tmp_path / "dns"))
+    with pytest.raises(NoEntriesFound):
+        store.get("bkt")
+    recs = [SrvRecord(host="10.0.0.1", port=9000, key="bkt")]
+    store.put("bkt", recs)
+    got = store.get("bkt")
+    assert got[0].host == "10.0.0.1" and got[0].port == 9000
+    assert "bkt" in store.list()
+    store.delete("bkt")
+    with pytest.raises(NoEntriesFound):
+        store.get("bkt")
+    store.delete("bkt")  # idempotent
+
+
+def test_file_store_shared_between_instances(tmp_path):
+    a = FileDNSStore(str(tmp_path / "shared"))
+    b = FileDNSStore(str(tmp_path / "shared"))
+    a.put("common", [SrvRecord(host="h1", port=1)])
+    assert b.get("common")[0].host == "h1"
+
+
+# ---------------------------------------------------------------------------
+# federated clusters
+# ---------------------------------------------------------------------------
+
+
+def _cluster(tmp_path, name, store_dir):
+    disks = [
+        XLStorage(str(tmp_path / f"{name}-d{i}")) for i in range(4)
+    ]
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    host, port = srv.endpoint.split("//")[1].rsplit(":", 1)
+    srv.bucket_dns = BucketDNS(
+        FileDNSStore(store_dir), host, int(port)
+    )
+    return srv
+
+
+@pytest.fixture()
+def federation(tmp_path):
+    store = str(tmp_path / "fed-dns")
+    a = _cluster(tmp_path, "a", store)
+    b = _cluster(tmp_path, "b", store)
+    yield a, b
+    a.shutdown()
+    b.shutdown()
+
+
+def test_bucket_names_globally_unique(federation):
+    a, b = federation
+    ca, cb = S3Client(a.endpoint), S3Client(b.endpoint)
+    assert ca.make_bucket("fedbkt").status == 200
+    # same name on the other cluster: taken by a different deployment
+    r = cb.make_bucket("fedbkt")
+    assert r.status == 409
+    assert r.error_code == "BucketAlreadyExists"
+    # re-create on the owner: already owned by you
+    r = ca.make_bucket("fedbkt")
+    assert r.status == 409
+    assert r.error_code == "BucketAlreadyOwnedByYou"
+
+
+def test_remote_bucket_redirects_to_owner(federation):
+    a, b = federation
+    ca, cb = S3Client(a.endpoint), S3Client(b.endpoint)
+    assert ca.make_bucket("abkt").status == 200
+    assert ca.put_object("abkt", "k", b"fed-data").status == 200
+    # cluster B does not hold the bucket: 307 to the owner
+    r = cb.get_object("abkt", "k")
+    assert r.status == 307, (r.status, r.body)
+    loc = r.headers.get("location", "")
+    assert a.endpoint in loc and loc.endswith("/abkt/k")
+    # following the redirect (signed against the owner) serves the data
+    assert ca.get_object("abkt", "k").body == b"fed-data"
+    # a bucket in NO cluster still 404s
+    assert cb.get_object("missing-bkt", "k").status == 404
+
+
+def test_federated_list_buckets_union(federation):
+    a, b = federation
+    ca, cb = S3Client(a.endpoint), S3Client(b.endpoint)
+    assert ca.make_bucket("from-a").status == 200
+    assert cb.make_bucket("from-b").status == 200
+    for c in (ca, cb):
+        r = c.request("GET", "/")
+        assert r.status == 200
+        assert b"from-a" in r.body and b"from-b" in r.body
+
+
+def test_delete_unregisters(federation):
+    a, b = federation
+    ca, cb = S3Client(a.endpoint), S3Client(b.endpoint)
+    assert ca.make_bucket("gone").status == 200
+    assert ca.request("DELETE", "/gone").status == 204
+    # the name is free for the other cluster now
+    assert cb.make_bucket("gone").status == 200
+
+
+def test_object_ops_via_owner_untouched(federation):
+    """Local buckets never consult the DNS on the hot path result."""
+    a, _b = federation
+    ca = S3Client(a.endpoint)
+    assert ca.make_bucket("local").status == 200
+    assert ca.put_object("local", "x", b"1").status == 200
+    assert ca.get_object("local", "x").body == b"1"
+    assert ca.request("DELETE", "/local/x").status == 204
+
+
+def test_dns_exclusive_create(tmp_path):
+    """Two clusters racing a CreateBucket: exactly one wins the
+    record (hard-link CAS, review r4)."""
+    store = FileDNSStore(str(tmp_path / "cas"))
+    store.create("race", [SrvRecord(host="a", port=1)])
+    from minio_tpu.cluster.dns import RecordExists
+
+    with pytest.raises(RecordExists):
+        store.create("race", [SrvRecord(host="b", port=2)])
+    assert store.get("race")[0].host == "a"
+    mem = MemoryDNSStore()
+    mem.create("race", [SrvRecord(host="a", port=1)])
+    with pytest.raises(RecordExists):
+        mem.create("race", [SrvRecord(host="b", port=2)])
+
+
+def test_redirect_uses_owner_scheme(federation):
+    a, b = federation
+    # rewrite A's record to claim https: B's redirect must honor it
+    recs = a.bucket_dns.store.list()
+    ca, cb = S3Client(a.endpoint), S3Client(b.endpoint)
+    assert ca.make_bucket("schemed").status == 200
+    rec = a.bucket_dns.lookup("schemed")[0]
+    rec.scheme = "https"
+    a.bucket_dns.store.put("schemed", [rec])
+    r = cb.get_object("schemed", "k")
+    assert r.status == 307
+    assert r.headers.get("location", "").startswith("https://")
+
+
+def test_web_delete_unregisters_dns(federation):
+    """web.DeleteBucket must free the federated name (review r4)."""
+    import http.client
+    import json as jsonmod
+
+    a, b = federation
+    ca, cb = S3Client(a.endpoint), S3Client(b.endpoint)
+    assert ca.make_bucket("webfed").status == 200
+
+    host, port = a.endpoint.split("//")[1].rsplit(":", 1)
+
+    def rpc(method, params, token=None):
+        h = {"Content-Type": "application/json"}
+        if token:
+            h["Authorization"] = f"Bearer {token}"
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        conn.request(
+            "POST", "/minio-tpu/webrpc",
+            jsonmod.dumps(
+                {"id": 1, "jsonrpc": "2.0", "method": method,
+                 "params": params}
+            ).encode(), h,
+        )
+        resp = conn.getresponse()
+        out = jsonmod.loads(resp.read())
+        conn.close()
+        return out
+
+    token = rpc(
+        "web.Login",
+        {"username": "minioadmin", "password": "minioadmin"},
+    )["result"]["token"]
+    assert "result" in rpc(
+        "web.DeleteBucket", {"bucketName": "webfed"}, token
+    )
+    # the name is free across the federation again
+    assert cb.make_bucket("webfed").status == 200
