@@ -110,6 +110,7 @@ class DataCrawler:
         tracker=None,
         cycle_bloom=None,
         leader_lock=None,
+        heal_hook=None,
     ):
         self._ol = object_layer
         self._meta = bucket_meta
@@ -126,6 +127,11 @@ class DataCrawler:
         # node would rotate every peer's bloom tracker with its own
         # unsynchronized counter and double-run lifecycle deletes
         self._leader_lock = leader_lock
+        # heal-on-crawl (the reference's healObjects pass inside the
+        # data scanner): on FULL sweeps, latest versions get a dry-run
+        # shard probe and damaged objects are queued here
+        self._heal_hook = heal_hook
+        self._heal_sweep = False  # set per sweep in _crawl_locked
         # ReplicationPool for the healReplication catch-up pass
         self._replication = replication
         # server callback hydrating a bucket's notification rules
@@ -360,10 +366,15 @@ class DataCrawler:
         except Exception:  # noqa: BLE001
             return prev
         resp = self._rotate_bloom(prev.cycles, next_cycle)
+        full_sweep = next_cycle % _FULL_SWEEP_EVERY == 0
         skip_ok = (
-            resp is not None
-            and resp.complete
-            and next_cycle % _FULL_SWEEP_EVERY != 0
+            resp is not None and resp.complete and not full_sweep
+        )
+        # shard-health probes ride the forced full sweep only: a
+        # dry-run heal per object is too heavy for every cycle (the
+        # reference gates its crawler heal the same way)
+        self._heal_sweep = self._heal_hook is not None and (
+            full_sweep or prev.cycles == 0
         )
         for b in buckets:
             bucket = b.name
@@ -429,6 +440,8 @@ class DataCrawler:
                 if oi.is_latest and not oi.delete_marker:
                     bu.objects += 1
                     bu.size += oi.size
+                    if self._heal_sweep:
+                        self._probe_heal(bucket, oi)
                     if fifo:
                         latest.append(oi)
                     # replication catch-up: PENDING/FAILED never made
@@ -491,6 +504,25 @@ class DataCrawler:
         self._abort_stale_uploads(bucket, lc)
         self._enforce_fifo_quota(bucket, bu, latest, versioned, suspended)
         return bu
+
+    def _probe_heal(self, bucket: str, oi) -> None:
+        """Metadata-only shard probe; queue a real heal for damaged
+        objects (healObject path of the reference's crawler).  The
+        probe is lock-free and reads no shard data - the expensive
+        verify happens inside the queued heal itself."""
+        probe = getattr(self._ol, "probe_object_health", None)
+        if probe is None:
+            self._heal_sweep = False  # backend has no heal surface
+            return
+        try:
+            res = probe(bucket, oi.name, oi.version_id)
+        except Exception:  # noqa: BLE001
+            return
+        if res.get("outdated"):
+            try:
+                self._heal_hook(bucket, oi.name, oi.version_id)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _enforce_fifo_quota(
         self, bucket, bu, latest, versioned, suspended

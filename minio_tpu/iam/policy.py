@@ -120,57 +120,164 @@ def _cond_values(args: Args, key: str) -> list[str]:
     return args.conditions.get(k, [])
 
 
-def _eval_condition(op: str, key: str, values: list[str], args: Args) -> bool:
-    got = _cond_values(args, key)
-    base = (
-        op[len("ForAllValues:"):]
-        if op.startswith("ForAllValues:")
-        else op
+def _parse_cond_date(raw: str) -> "float | None":
+    """ISO-8601 or epoch-seconds -> unix timestamp."""
+    import datetime
+
+    raw = raw.strip()
+    if raw.isdigit():
+        return float(raw)
+    try:
+        dt = datetime.datetime.fromisoformat(
+            raw.replace("Z", "+00:00")
+        )
+    except ValueError:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt.timestamp()
+
+
+def _num_cmp(base: str, g: str, values: "list[str]") -> bool:
+    try:
+        gv = float(g)
+        vs = [float(v) for v in values]
+    except ValueError:
+        return False
+    return any(
+        {
+            "NumericEquals": gv == v,
+            "NumericNotEquals": gv != v,
+            "NumericLessThan": gv < v,
+            "NumericLessThanEquals": gv <= v,
+            "NumericGreaterThan": gv > v,
+            "NumericGreaterThanEquals": gv >= v,
+        }[base]
+        for v in vs
     )
+
+
+def _date_cmp(base: str, g: str, values: "list[str]") -> bool:
+    gv = _parse_cond_date(g)
+    if gv is None:
+        return False
+    out = False
+    for v in values:
+        vv = _parse_cond_date(v)
+        if vv is None:
+            continue
+        out = out or {
+            "DateEquals": gv == vv,
+            "DateNotEquals": gv != vv,
+            "DateLessThan": gv < vv,
+            "DateLessThanEquals": gv <= vv,
+            "DateGreaterThan": gv > vv,
+            "DateGreaterThanEquals": gv >= vv,
+        }[base]
+    return out
+
+
+def _one_value_matches(base: str, g: str, values: "list[str]") -> bool:
+    """Does ONE context value satisfy the operator against the policy
+    value set?  (pkg/iam/policy condition function library.)"""
     if base in ("StringEquals", "StringLike"):
-        if not got:
-            return False
         like = base == "StringLike"
         return any(
-            (wildcard_match(v, g) if like else v == g)
-            for v in values
-            for g in got
+            (wildcard_match(v, g) if like else v == g) for v in values
         )
-    if base in ("StringNotEquals", "StringNotLike"):
-        like = base == "StringNotLike"
-        return not any(
-            (wildcard_match(v, g) if like else v == g)
-            for v in values
-            for g in got
-        )
-    if base in ("IpAddress", "NotIpAddress"):
-        nets = []
-        for v in values:
-            try:
-                nets.append(ipaddress.ip_network(v, strict=False))
-            except ValueError:
-                continue
-        hit = False
-        for g in got:
-            try:
-                addr = ipaddress.ip_address(g)
-            except ValueError:
-                continue
-            if any(addr in net for net in nets):
-                hit = True
-        return hit if base == "IpAddress" else not hit
-    if base == "NumericLessThanEquals":
+    if base in ("StringEqualsIgnoreCase",):
+        return any(v.lower() == g.lower() for v in values)
+    if base.startswith("Numeric"):
+        return _num_cmp(base, g, values)
+    if base.startswith("Date"):
+        return _date_cmp(base, g, values)
+    if base == "Bool":
+        return g.lower() in [v.lower() for v in values]
+    if base == "IpAddress":
         try:
-            lim = min(int(v) for v in values)
+            addr = ipaddress.ip_address(g)
         except ValueError:
             return False
-        return all(g.isdigit() and int(g) <= lim for g in got) and bool(got)
-    if base == "Bool":
-        want = [v.lower() for v in values]
-        return any(g.lower() in want for g in got)
-    # unknown operator: no match (conservative deny for Allow
-    # statements, no effect for Deny)
-    return False
+        for v in values:
+            try:
+                if addr in ipaddress.ip_network(v, strict=False):
+                    return True
+            except ValueError:
+                continue
+        return False
+    raise KeyError(base)  # unreachable: _KNOWN_OPS gates callers
+
+
+_NEGATED = {
+    "StringNotEquals": "StringEquals",
+    "StringNotLike": "StringLike",
+    "StringNotEqualsIgnoreCase": "StringEqualsIgnoreCase",
+    "NotIpAddress": "IpAddress",
+    "NumericNotEquals": "NumericEquals",
+    "DateNotEquals": "DateEquals",
+}
+
+# every operator _one_value_matches understands; checked up front so
+# a typo'd operator NEVER matches, even under a vacuous ForAllValues
+_KNOWN_OPS = frozenset(
+    [
+        "StringEquals",
+        "StringLike",
+        "StringEqualsIgnoreCase",
+        "Bool",
+        "IpAddress",
+    ]
+    + [
+        f"Numeric{suffix}"
+        for suffix in (
+            "Equals", "LessThan", "LessThanEquals",
+            "GreaterThan", "GreaterThanEquals",
+        )
+    ]
+    + [
+        f"Date{suffix}"
+        for suffix in (
+            "Equals", "LessThan", "LessThanEquals",
+            "GreaterThan", "GreaterThanEquals",
+        )
+    ]
+)
+
+
+def _eval_condition(op: str, key: str, values: list[str], args: Args) -> bool:
+    got = _cond_values(args, key)
+    qualifier = ""
+    base = op
+    for q in ("ForAllValues:", "ForAnyValue:"):
+        if op.startswith(q):
+            qualifier, base = q[:-1], op[len(q):]
+            break
+    if base == "Null":
+        want_absent = values and values[0].lower() == "true"
+        return (not got) if want_absent else bool(got)
+    neg = base in _NEGATED
+    pos_base = _NEGATED.get(base, base)
+    if pos_base not in _KNOWN_OPS:
+        # unknown operator: no match (conservative deny for Allow
+        # statements, no effect for Deny)
+        return False
+
+    def pred(g: str) -> bool:
+        """Does ONE context value satisfy the (possibly negated)
+        operator?  The qualifier quantifies over this predicate."""
+        hit = _one_value_matches(pos_base, g, values)
+        return (not hit) if neg else hit
+
+    if qualifier == "ForAllValues":
+        # vacuously true on an absent key (AWS set-operator semantics)
+        return all(pred(g) for g in got)
+    if qualifier == "ForAnyValue":
+        return any(pred(g) for g in got)
+    if neg:
+        # default negated ops: true on an absent key, else EVERY
+        # context value must satisfy the negation
+        return all(pred(g) for g in got)
+    return bool(got) and any(pred(g) for g in got)
 
 
 # ---------------------------------------------------------------------------

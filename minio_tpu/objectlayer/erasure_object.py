@@ -1133,6 +1133,35 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     pass
             return result
 
+    def probe_object_health(
+        self, bucket, object_name, version_id=""
+    ) -> dict:
+        """Metadata-only shard-health probe for the crawler's
+        heal-on-crawl pass: per-disk xl.meta quorum compare, NO
+        namespace lock, NO shard reads, NO heal_bucket fan-out - a
+        full sweep must not serialize against live traffic.  A racy
+        false positive only queues a heal that then finds nothing."""
+        disks = self._online_disks()
+        fis, _errs = read_all_fileinfo(
+            disks, bucket, object_name, version_id
+        )
+        fi = find_fileinfo_in_quorum(fis, self.read_quorum)
+        outdated = [
+            i
+            for i, (d, f) in enumerate(zip(disks, fis))
+            if d is not None
+            and (
+                f is None
+                or f.mod_time_ns != fi.mod_time_ns
+                or f.data_dir != fi.data_dir
+            )
+        ]
+        return {
+            "bucket": bucket,
+            "object": object_name,
+            "outdated": outdated,
+        }
+
     def heal_object(
         self, bucket, object_name, version_id="", dry_run=False
     ) -> dict:

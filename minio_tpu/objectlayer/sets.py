@@ -155,6 +155,11 @@ class ErasureSets(ObjectLayer):
             bucket, object_name, version_id, dry_run
         )
 
+    def probe_object_health(self, bucket, object_name, version_id=""):
+        return self.set_for(object_name).probe_object_health(
+            bucket, object_name, version_id
+        )
+
     def heal_bucket(self, bucket, dry_run=False):
         """Tolerant fan-out: one bad set must not block healing the
         rest (erasure-healing.go healBucket sweeps every set)."""

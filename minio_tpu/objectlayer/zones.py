@@ -272,6 +272,10 @@ class ErasureZones(ObjectLayer):
         z = self._find_zone(bucket, object_name, version_id)
         return z.heal_object(bucket, object_name, version_id, dry_run)
 
+    def probe_object_health(self, bucket, object_name, version_id=""):
+        z = self._find_zone(bucket, object_name, version_id)
+        return z.probe_object_health(bucket, object_name, version_id)
+
     def heal_bucket(self, bucket, dry_run=False):
         healed = []
         found = False

@@ -77,7 +77,7 @@ def _lex(text: str) -> "list[_Token]":
     while pos < len(text):
         m = _TOKEN_RE.match(text, pos)
         if m is None:
-            raise SQLError(f"bad character {text[pos]!r} at {pos}")
+            raise SQLError(f"bad character {text[pos]!r} at {pos}", "LexerInvalidChar")
         pos = m.end()
         kind = m.lastgroup
         if kind == "ws":
@@ -192,7 +192,7 @@ class Arith(Expr):
             if nb == 0:
                 raise SQLError("modulo by zero", "InvalidDataType")
             return na % nb
-        raise SQLError(f"unknown operator {self.op}")
+        raise SQLError(f"unknown operator {self.op}", "ParseUnknownOperator")
 
     def walk(self):
         yield self
@@ -226,7 +226,7 @@ def _compare(op: str, a, b):
             return a >= b
     except TypeError:
         return False
-    raise SQLError(f"unknown comparison {op}")
+    raise SQLError(f"unknown comparison {op}", "ParseUnknownOperator")
 
 
 class Compare(Expr):
@@ -378,7 +378,7 @@ class Logical(Expr):
             if b is not None and _truthy(b):
                 return True
             return None if (a is None or b is None) else False
-        raise SQLError(f"unknown logical {self.op}")
+        raise SQLError(f"unknown logical {self.op}", "ParseUnknownOperator")
 
     def walk(self):
         yield self
@@ -566,7 +566,7 @@ class _Parser:
     def expect_kw(self, kw: str):
         t = self.next()
         if t.kind != "kw" or t.value != kw:
-            raise SQLError(f"expected {kw.upper()}, got {t.value!r}")
+            raise SQLError(f"expected {kw.upper()}, got {t.value!r}", "ParseExpectedKeyword")
 
     def accept_kw(self, kw: str) -> bool:
         t = self.peek()
@@ -624,12 +624,12 @@ class _Parser:
         if t.kind == "kw" and t.value == "in":
             self.pos += 1
             if not self.accept_op("("):
-                raise SQLError("expected ( after IN")
+                raise SQLError("expected ( after IN", "ParseExpectedTokenType")
             opts = [self.parse_expr()]
             while self.accept_op(","):
                 opts.append(self.parse_expr())
             if not self.accept_op(")"):
-                raise SQLError("expected ) after IN list")
+                raise SQLError("expected ) after IN list", "ParseExpectedTokenType")
             return In(left, opts, negate)
         if t.kind == "kw" and t.value == "like":
             self.pos += 1
@@ -645,7 +645,7 @@ class _Parser:
                 return IsNull(left, neg)
             if self.accept_kw("missing"):
                 return IsNull(left, neg, missing_only=True)
-            raise SQLError("expected NULL or MISSING after IS")
+            raise SQLError("expected NULL or MISSING after IS", "ParseExpectedKeyword")
         return left
 
     def _additive(self) -> Expr:
@@ -689,19 +689,19 @@ class _Parser:
             return Literal(None)
         if t.kind == "kw" and t.value == "cast":
             if not self.accept_op("("):
-                raise SQLError("expected ( after CAST")
+                raise SQLError("expected ( after CAST", "ParseExpectedLeftParenAfterCast")
             e = self.parse_expr()
             self.expect_kw("as")
             tt = self.next()
             if tt.kind not in ("ident", "kw"):
-                raise SQLError("expected type name in CAST")
+                raise SQLError("expected type name in CAST", "ParseExpectedTypeName")
             if not self.accept_op(")"):
-                raise SQLError("expected ) after CAST")
+                raise SQLError("expected ) after CAST", "ParseCastArity")
             return Cast(e, str(tt.value))
         if t.kind == "op" and t.value == "(":
             e = self.parse_expr()
             if not self.accept_op(")"):
-                raise SQLError("missing )")
+                raise SQLError("missing )", "ParseExpectedTokenType")
             return e
         if t.kind in ("ident", "qident"):
             name = t.value
@@ -715,9 +715,9 @@ class _Parser:
                     else:
                         arg = self.parse_expr()
                     if not self.accept_op(")"):
-                        raise SQLError("missing ) in aggregate")
+                        raise SQLError("missing ) in aggregate", "ParseExpectedTokenType")
                     if low != "count" and arg is None:
-                        raise SQLError(f"{low.upper()} needs an argument")
+                        raise SQLError(f"{low.upper()} needs an argument", "EvaluatorInvalidArguments")
                     return Aggregate(low, arg)
                 args: list[Expr] = []
                 if not self.accept_op(")"):
@@ -725,7 +725,7 @@ class _Parser:
                     while self.accept_op(","):
                         args.append(self.parse_expr())
                     if not self.accept_op(")"):
-                        raise SQLError("missing ) in call")
+                        raise SQLError("missing ) in call", "ParseExpectedTokenType")
                 if low not in _SCALAR_FUNCS:
                     raise SQLError(
                         f"unsupported function {name}",
@@ -737,10 +737,10 @@ class _Parser:
             while self.accept_op("."):
                 nt = self.next()
                 if nt.kind not in ("ident", "qident"):
-                    raise SQLError("bad column path")
+                    raise SQLError("bad column path", "InvalidKeyPath")
                 parts.append(nt.value)
             return Column(".".join(parts))
-        raise SQLError(f"unexpected token {t.value!r}")
+        raise SQLError(f"unexpected token {t.value!r}", "ParseUnexpectedToken")
 
 
 class Projection:
@@ -869,7 +869,7 @@ def parse(expression: str) -> SelectStatement:
             if p.accept_kw("as"):
                 t = p.next()
                 if t.kind not in ("ident", "qident"):
-                    raise SQLError("bad alias")
+                    raise SQLError("bad alias", "ParseExpectedIdentForAlias")
                 alias = t.value
             elif p.peek().kind in ("ident", "qident"):
                 alias = p.next().value
@@ -890,12 +890,12 @@ def parse(expression: str) -> SelectStatement:
     while p.accept_op("."):
         step = p.next()  # json path steps on the table: accepted, ignored
         if step.kind not in ("ident", "qident"):
-            raise SQLError("bad table path after FROM S3Object.")
+            raise SQLError("bad table path after FROM S3Object.", "InvalidKeyPath")
     table_alias = ""
     if p.accept_kw("as"):
         at = p.next()
         if at.kind not in ("ident", "qident"):
-            raise SQLError("bad table alias")
+            raise SQLError("bad table alias", "InvalidTableAlias")
         table_alias = at.value
     elif p.peek().kind == "ident":
         table_alias = p.next().value
@@ -906,10 +906,10 @@ def parse(expression: str) -> SelectStatement:
     if p.accept_kw("limit"):
         lt = p.next()
         if lt.kind != "number" or not isinstance(lt.value, int):
-            raise SQLError("LIMIT needs an integer")
+            raise SQLError("LIMIT needs an integer", "ParseExpectedNumber")
         limit = lt.value
     if p.peek().kind != "eof":
-        raise SQLError(f"trailing tokens at {p.peek().value!r}")
+        raise SQLError(f"trailing tokens at {p.peek().value!r}", "ParseUnexpectedToken")
     stmt = SelectStatement(projections, where, limit, table_alias)
     stmt.bind()
     return stmt

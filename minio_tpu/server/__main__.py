@@ -517,6 +517,13 @@ def main(argv=None) -> int:
         ensure_event_rules=srv.ensure_event_rules,
         replication=srv.replication,
         cycle_bloom=_cluster_bloom,
+        # heal-on-crawl: full sweeps probe shard health and feed the
+        # MRF heal queue (data scanner healObject path)
+        heal_hook=(
+            srv.heal_queue.push_object
+            if getattr(srv, "heal_queue", None) is not None
+            else None
+        ),
         # distributed: elect one sweeping node per cycle via the lock
         # plane (single node: the local _crawl_mu already serializes)
         leader_lock=(

@@ -139,6 +139,66 @@ _E = {
     "UnsupportedSqlStructure": ("Encountered an unsupported SQL structure. Check the SQL Reference.", H.BAD_REQUEST),
     "UnsupportedSyntax": ("Encountered invalid syntax.", H.BAD_REQUEST),
     "MissingRequiredParameter": ("The SelectRequest entity is missing a required parameter. Check the service documentation and try again.", H.BAD_REQUEST),
+    # -- S3 Select SQL lexer/parser family (pkg/s3select/sql surfaced
+    #    through api-errors.go); one code per distinguishable parse
+    #    state so SDK retries/diagnostics behave like upstream
+    "LexerInvalidChar": ("The SQL expression contains an invalid character.", H.BAD_REQUEST),
+    "LexerInvalidOperator": ("The SQL expression contains an invalid operator.", H.BAD_REQUEST),
+    "LexerInvalidLiteral": ("The SQL expression contains an invalid literal.", H.BAD_REQUEST),
+    "ParseUnexpectedToken": ("The SQL expression contains an unexpected token.", H.BAD_REQUEST),
+    "ParseUnexpectedKeyword": ("The SQL expression contains an unexpected keyword.", H.BAD_REQUEST),
+    "ParseUnexpectedOperator": ("The SQL expression contains an unexpected operator.", H.BAD_REQUEST),
+    "ParseUnexpectedTerm": ("The SQL expression contains an unexpected term.", H.BAD_REQUEST),
+    "ParseExpectedExpression": ("Did not find the expected SQL expression.", H.BAD_REQUEST),
+    "ParseExpectedKeyword": ("Did not find the expected keyword in the SQL expression.", H.BAD_REQUEST),
+    "ParseExpectedTokenType": ("Did not find the expected token in the SQL expression.", H.BAD_REQUEST),
+    "ParseExpectedNumber": ("Did not find the expected number in the SQL expression.", H.BAD_REQUEST),
+    "ParseExpectedIdentForAlias": ("Did not find the expected identifier for the alias in the SQL expression.", H.BAD_REQUEST),
+    "ParseExpectedArgumentDelimiter": ("Did not find the expected argument delimiter in the SQL expression.", H.BAD_REQUEST),
+    "ParseEmptySelect": ("The SQL expression contains an empty SELECT.", H.BAD_REQUEST),
+    "ParseSelectMissingFrom": ("The SQL expression contains a missing FROM after SELECT list.", H.BAD_REQUEST),
+    "ParseExpectedMember": ("The SQL expression contains an invalid member reference.", H.BAD_REQUEST),
+    "ParseAsteriskIsNotAloneInSelectList": ("Other expressions are not allowed in the SELECT list when '*' is used without dot notation in the SQL expression.", H.BAD_REQUEST),
+    "ParseInvalidContextForWildcardInSelectList": ("Invalid use of '*' in the SELECT list of the SQL expression.", H.BAD_REQUEST),
+    "ParseCastArity": ("The SQL expression CAST has incorrect arity.", H.BAD_REQUEST),
+    "ParseExpectedLeftParenAfterCast": ("Did not find the expected left parenthesis after CAST in the SQL expression.", H.BAD_REQUEST),
+    "ParseExpectedTypeName": ("Did not find the expected type name after CAST in the SQL expression.", H.BAD_REQUEST),
+    "ParseInvalidTypeParam": ("The SQL expression contains an invalid parameter value for a type.", H.BAD_REQUEST),
+    "ParseUnsupportedSyntax": ("The SQL expression contains unsupported syntax.", H.BAD_REQUEST),
+    "ParseUnsupportedSelect": ("The SQL expression contains an unsupported use of SELECT.", H.BAD_REQUEST),
+    "ParseUnsupportedCallWithStar": ("Only COUNT may be used with '*' in the SQL expression.", H.BAD_REQUEST),
+    "ParseUnsupportedCase": ("The SQL expression contains an unsupported use of CASE.", H.BAD_REQUEST),
+    "ParseUnsupportedLiteralsGroupBy": ("The SQL expression contains an unsupported use of GROUP BY.", H.BAD_REQUEST),
+    "ParseUnsupportedAlias": ("The SQL expression contains an unsupported use of an alias.", H.BAD_REQUEST),
+    "ParseUnknownOperator": ("The SQL expression contains an invalid operator.", H.BAD_REQUEST),
+    "ParseMalformedJoin": ("JOIN is not supported in the SQL expression.", H.BAD_REQUEST),
+    "ParseNonUnaryAgregateFunctionCall": ("Only one argument is supported for aggregate functions in the SQL expression.", H.BAD_REQUEST),
+    "EvaluatorInvalidArguments": ("Incorrect number of arguments in the function call in the SQL expression.", H.BAD_REQUEST),
+    "EvaluatorInvalidTimestampFormatPattern": ("The timestamp format pattern contains an invalid format specifier in the SQL expression.", H.BAD_REQUEST),
+    "EvaluatorBindingDoesNotExist": ("A column name or a path provided does not exist in the SQL expression.", H.BAD_REQUEST),
+    "InvalidCast": ("Attempt to convert from one data type to another using CAST failed in the SQL expression.", H.BAD_REQUEST),
+    "CastFailed": ("Attempt to convert from one data type to another using CAST failed in the SQL expression.", H.BAD_REQUEST),
+    "InvalidDataType": ("The SQL expression contains an invalid data type.", H.BAD_REQUEST),
+    "InvalidColumnIndex": ("The column index in the SQL expression is invalid.", H.BAD_REQUEST),
+    "InvalidKeyPath": ("The key path in the SQL expression is invalid.", H.BAD_REQUEST),
+    "InvalidTableAlias": ("The SQL expression contains an invalid table alias.", H.BAD_REQUEST),
+    "IntegerOverflow": ("An integer overflow or underflow occurred in the SQL expression.", H.BAD_REQUEST),
+    "LikeInvalidInputs": ("Invalid argument given to the LIKE clause in the SQL expression.", H.BAD_REQUEST),
+    "IllegalSqlFunctionArgument": ("Illegal argument was used in the SQL function.", H.BAD_REQUEST),
+    "IncorrectSqlFunctionArgumentType": ("Incorrect type of arguments in the function call in the SQL expression.", H.BAD_REQUEST),
+    "ExpressionTooLong": ("The SQL expression is too long: the maximum byte-length for the SQL expression is 256 KB.", H.BAD_REQUEST),
+    "MissingHeaders": ("Some headers in the query are missing from the file. Check the file and try again.", H.BAD_REQUEST),
+    "ValueParseFailure": ("Time stamp parse failure in the SQL expression.", H.BAD_REQUEST),
+    "ObjectSerializationConflict": ("The SelectRequest entity contains more than one data serialization format.", H.BAD_REQUEST),
+    # -- misc long-tail (api-errors.go)
+    "UnsupportedRangeHeader": ("Range header type is not supported - only bytes ranges are accepted.", H.BAD_REQUEST),
+    "UnauthorizedAccess": ("You are not authorized to perform this operation.", H.UNAUTHORIZED),
+    "Busy": ("The service is unavailable, please retry.", H.SERVICE_UNAVAILABLE),
+    "MissingFields": ("A required field in the request is missing.", H.BAD_REQUEST),
+    "NoSuchBucketLifecycle": ("The bucket lifecycle configuration does not exist.", H.NOT_FOUND),
+    "IllegalVersioningConfigurationException": ("The versioning configuration specified in the request is invalid.", H.BAD_REQUEST),
+    "PostPolicyInvalidKeyName": ("Invalid according to Policy: Policy Condition failed.", H.FORBIDDEN),
+    "AuthorizationParametersError": ("The authorization parameters in the request are invalid.", H.BAD_REQUEST),
 }
 
 

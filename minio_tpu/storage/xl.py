@@ -126,9 +126,13 @@ class XLStorage(StorageAPI):
 
     def make_vol(self, volume: str) -> None:
         vp = self._vol_path(volume)
-        if os.path.isdir(vp):
-            raise errors.VolumeExists(volume)
-        os.makedirs(vp)
+        try:
+            os.makedirs(vp)
+        except FileExistsError:
+            # atomic exists-check: a concurrent MakeVol racing this
+            # one must surface VolumeExists, not an OS error that the
+            # quorum reducer would count as a disk failure
+            raise errors.VolumeExists(volume) from None
 
     def list_vols(self) -> list[VolInfo]:
         out = []

@@ -511,3 +511,120 @@ def test_upload_part_copy_not_implemented(iam_server, root_client):
     )
     assert r.status == 501
     c.request("DELETE", "/shared/mpk", query={"uploadId": uid})
+
+
+def test_condition_operator_library():
+    """Numeric/Date/Null/IgnoreCase/ForAnyValue operators
+    (pkg/iam/policy condition functions, review r4 expansion)."""
+    def policy_with(cond):
+        return Policy.from_json(json.dumps({
+            "Version": "2012-10-17",
+            "Statement": [{
+                "Effect": "Allow",
+                "Action": "s3:GetObject",
+                "Resource": "arn:aws:s3:::condbkt/*",
+                "Condition": cond,
+            }],
+        }))
+
+    def allowed(p, **conds):
+        return p.is_allowed(Args(
+            account="u", action="s3:GetObject", bucket="condbkt",
+            object="k",
+            conditions={k: v for k, v in conds.items()},
+        ))
+
+    p = policy_with({"NumericLessThan": {"s3:max-keys": "100"}})
+    assert allowed(p, **{"max-keys": ["50"]})
+    assert not allowed(p, **{"max-keys": ["100"]})
+    assert not allowed(p)  # absent key fails a positive operator
+
+    p = policy_with({"NumericGreaterThanEquals": {"s3:max-keys": "10"}})
+    assert allowed(p, **{"max-keys": ["10"]})
+    assert not allowed(p, **{"max-keys": ["9"]})
+
+    p = policy_with(
+        {"DateGreaterThan": {"aws:CurrentTime": "2020-01-01T00:00:00Z"}}
+    )
+    assert allowed(p, currenttime=["2024-06-01T00:00:00Z"])
+    assert not allowed(p, currenttime=["2019-06-01T00:00:00Z"])
+
+    p = policy_with({"StringEqualsIgnoreCase": {"s3:prefix": "Docs/"}})
+    assert allowed(p, prefix=["docs/"])
+    assert not allowed(p, prefix=["other/"])
+
+    # Null: true = key must be ABSENT
+    p = policy_with({"Null": {"s3:prefix": "true"}})
+    assert allowed(p)
+    assert not allowed(p, prefix=["x"])
+
+    # negated operators match when the key is absent (AWS semantics)
+    p = policy_with({"StringNotEquals": {"s3:prefix": "secret/"}})
+    assert allowed(p, prefix=["public/"])
+    assert allowed(p)
+    assert not allowed(p, prefix=["secret/"])
+
+    # ForAllValues: vacuous on absent, every value must match
+    p = policy_with(
+        {"ForAllValues:StringEquals": {"s3:prefix": ["a/", "b/"]}}
+    )
+    assert allowed(p)
+    assert allowed(p, prefix=["a/"])
+    assert not allowed(p, prefix=["a/", "z/"])
+
+    # ForAnyValue: at least one
+    p = policy_with(
+        {"ForAnyValue:StringEquals": {"s3:prefix": ["a/", "b/"]}}
+    )
+    assert not allowed(p)
+    assert allowed(p, prefix=["z/", "b/"])
+
+
+def test_negated_operator_qualifier_semantics():
+    """ForAnyValue over a negated op: at least one context value must
+    satisfy the negation (review r4); unknown operators never match,
+    even under a vacuous ForAllValues."""
+    deny = Policy.from_json(json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Action": "s3:GetObject",
+                "Resource": "arn:aws:s3:::nb/*",
+            },
+            {
+                "Effect": "Deny",
+                "Action": "s3:GetObject",
+                "Resource": "arn:aws:s3:::nb/*",
+                "Condition": {
+                    "ForAnyValue:StringNotEquals": {"s3:prefix": "a"}
+                },
+            },
+        ],
+    }))
+
+    def allowed(**conds):
+        return deny.is_allowed(Args(
+            account="u", action="s3:GetObject", bucket="nb",
+            object="k", conditions=conds,
+        ))
+
+    assert allowed(prefix=["a"])          # only matching values: no deny
+    assert not allowed(prefix=["a", "b"])  # "b" != "a" -> deny fires
+    assert not allowed(prefix=["z"])
+
+    typo = Policy.from_json(json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{
+            "Effect": "Allow",
+            "Action": "s3:GetObject",
+            "Resource": "arn:aws:s3:::nb/*",
+            "Condition": {
+                "ForAllValues:NumericLesserThan": {"s3:max-keys": "10"}
+            },
+        }],
+    }))
+    # mistyped operator: never grants, even with the key absent
+    assert not typo.is_allowed(Args(
+        account="u", action="s3:GetObject", bucket="nb", object="k",
+    ))

@@ -1,0 +1,289 @@
+"""Systematic concurrency stress harness (SURVEY §5 race discipline).
+
+The reference leans on Go's race detector in CI; Python has no
+equivalent, so this harness drives MIXED concurrent operations
+against shared layers and asserts the invariants a linearizable
+object store must keep:
+
+- a GET returns SOME complete version's bytes, never a torn mix;
+- concurrent overwrites of one key leave exactly one winner whose
+  GET, info and ETag agree;
+- concurrent multipart uploads to one key interleave without
+  corrupting either upload's parts;
+- the final namespace equals the set of keys whose deletes lost.
+"""
+
+import hashlib
+import io
+import os
+import threading
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+BLOCK = 4096
+THREADS = 8
+ROUNDS = 12
+
+
+@pytest.fixture()
+def layer(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    ol.make_bucket("raceb")
+    return ol
+
+
+def _run_all(workers):
+    errs = []
+
+    def wrap(fn):
+        def inner():
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                import traceback
+
+                errs.append(
+                    f"{type(e).__name__}: {e}\n"
+                    + traceback.format_exc(limit=4)
+                )
+
+        return inner
+
+    threads = [
+        threading.Thread(target=wrap(fn), daemon=True)
+        for fn in workers
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "worker hung"
+    assert not errs, errs[0]
+
+
+def test_concurrent_overwrites_one_winner(layer):
+    """N writers hammer ONE key; every concurrent read returns some
+    complete payload and the final state is one winner."""
+    payloads = {
+        i: bytes([i]) * (3000 + i) for i in range(THREADS)
+    }
+    valid = {hashlib.md5(p).hexdigest() for p in payloads.values()}
+    stop = threading.Event()
+
+    def writer(i):
+        def go():
+            for _ in range(ROUNDS):
+                layer.put_object(
+                    "raceb", "hot", io.BytesIO(payloads[i]),
+                    len(payloads[i]),
+                )
+
+        return go
+
+    reads = [0]
+    read_errs = []
+
+    def reader():
+        while not stop.is_set():
+            buf = io.BytesIO()
+            try:
+                layer.get_object("raceb", "hot", buf)
+            except Exception:  # noqa: BLE001
+                continue  # key may not exist yet
+            got = buf.getvalue()
+            reads[0] += 1
+            if hashlib.md5(got).hexdigest() not in valid:
+                read_errs.append(f"torn read: {len(got)} bytes")
+                return
+
+    # readers run CONCURRENTLY with the writers, stopping after them
+    reader_threads = [
+        threading.Thread(target=reader, daemon=True) for _ in range(2)
+    ]
+    for t in reader_threads:
+        t.start()
+    try:
+        _run_all([writer(i) for i in range(THREADS)])
+    finally:
+        stop.set()
+    for t in reader_threads:
+        t.join(timeout=60)
+    assert not read_errs, read_errs[0]
+    assert reads[0] > 0, "readers never observed the key"
+    info = layer.get_object_info("raceb", "hot")
+    buf = io.BytesIO()
+    layer.get_object("raceb", "hot", buf)
+    final = buf.getvalue()
+    assert hashlib.md5(final).hexdigest() == info.etag
+    assert info.etag in valid
+
+
+def test_concurrent_distinct_keys_all_land(layer):
+    def writer(i):
+        def go():
+            for r in range(ROUNDS):
+                data = f"{i}:{r}".encode() * 100
+                layer.put_object(
+                    "raceb", f"k-{i}-{r}", io.BytesIO(data), len(data)
+                )
+
+        return go
+
+    _run_all([writer(i) for i in range(THREADS)])
+    names = [
+        o.name
+        for o in layer.list_objects("raceb", max_keys=1000).objects
+    ]
+    assert len(names) == THREADS * ROUNDS
+    # spot-check integrity across the namespace
+    for i in (0, THREADS - 1):
+        buf = io.BytesIO()
+        layer.get_object("raceb", f"k-{i}-0", buf)
+        assert buf.getvalue() == f"{i}:0".encode() * 100
+
+
+def test_concurrent_put_delete_converges(layer):
+    """PUT and DELETE race per key; afterwards every key is either
+    fully present (readable, correct bytes) or fully absent."""
+    from minio_tpu.objectlayer.api import ObjectNotFound
+
+    keys = [f"pd-{i}" for i in range(THREADS)]
+
+    def putter(k, data):
+        def go():
+            for _ in range(ROUNDS):
+                layer.put_object(
+                    "raceb", k, io.BytesIO(data), len(data)
+                )
+
+        return go
+
+    def deleter(k):
+        def go():
+            for _ in range(ROUNDS):
+                try:
+                    layer.delete_object("raceb", k)
+                except ObjectNotFound:
+                    pass
+
+        return go
+
+    datas = {k: k.encode() * 500 for k in keys}
+    _run_all(
+        [putter(k, datas[k]) for k in keys]
+        + [deleter(k) for k in keys]
+    )
+    for k in keys:
+        buf = io.BytesIO()
+        try:
+            layer.get_object("raceb", k, buf)
+        except ObjectNotFound:
+            continue  # fully absent: fine
+        assert buf.getvalue() == datas[k]
+
+
+def test_concurrent_multipart_uploads_one_key(layer):
+    from minio_tpu.objectlayer.api import CompletePart
+
+    def uploader(i):
+        def go():
+            data1 = bytes([i]) * (6 << 20)
+            data2 = bytes([i]) * 1000
+            uid = layer.new_multipart_upload("raceb", "mpkey", {})
+            p1 = layer.put_object_part(
+                "raceb", "mpkey", uid, 1, io.BytesIO(data1), len(data1)
+            )
+            p2 = layer.put_object_part(
+                "raceb", "mpkey", uid, 2, io.BytesIO(data2), len(data2)
+            )
+            layer.complete_multipart_upload(
+                "raceb", "mpkey", uid,
+                [CompletePart(1, p1.etag), CompletePart(2, p2.etag)],
+            )
+
+        return go
+
+    _run_all([uploader(i) for i in range(4)])
+    buf = io.BytesIO()
+    info = layer.get_object_info("raceb", "mpkey")
+    layer.get_object("raceb", "mpkey", buf)
+    got = buf.getvalue()
+    # one uploader won wholesale: uniform bytes, full length
+    assert len(got) == (6 << 20) + 1000
+    assert len(set(got)) == 1
+    assert info.size == len(got)
+    # no multipart staging leaked
+    assert layer.list_multipart_uploads("raceb") == []
+
+
+def test_concurrent_bucket_create_delete(layer):
+    from minio_tpu.objectlayer.api import (
+        BucketExists,
+        BucketNotFound,
+    )
+
+    def cycler(i):
+        def go():
+            for _ in range(ROUNDS):
+                try:
+                    layer.make_bucket("churn")
+                except BucketExists:
+                    pass
+                try:
+                    layer.delete_bucket("churn", force=True)
+                except BucketNotFound:
+                    pass
+
+        return go
+
+    _run_all([cycler(i) for i in range(4)])
+    # converged: either present or absent, never half-created
+    try:
+        layer.get_bucket_info("churn")
+        present = True
+    except BucketNotFound:
+        present = False
+    if present:
+        layer.delete_bucket("churn", force=True)
+
+
+def test_concurrent_server_requests(tmp_path):
+    """The same invariants through the REAL server: SigV4, routing,
+    admission, events all in the hot path."""
+    disks = [XLStorage(str(tmp_path / f"sd{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    try:
+        boot = S3Client(srv.endpoint)
+        assert boot.make_bucket("srvrace").status == 200
+        payloads = {
+            i: os.urandom(2000 + i) for i in range(THREADS)
+        }
+
+        def worker(i):
+            def go():
+                c = S3Client(srv.endpoint)  # own connection per thread
+                for r in range(ROUNDS):
+                    key = f"w{i}-{r % 3}"
+                    assert c.put_object(
+                        "srvrace", key, payloads[i]
+                    ).status == 200
+                    got = c.get_object("srvrace", key)
+                    if got.status == 200:
+                        assert got.body in payloads.values()
+                    c.request("DELETE", f"/srvrace/w{i}-2")
+
+            return go
+
+        _run_all([worker(i) for i in range(THREADS)])
+        r = boot.list_objects("srvrace")
+        assert r.status == 200
+    finally:
+        srv.shutdown()

@@ -299,3 +299,31 @@ def test_crawler_freshness_gate_under_leadership(zones):
     assert crawler.usage().cycles == 1
     crawler.crawl_once(force=True)  # admin trigger bypasses the gate
     assert crawler.usage().cycles == 2
+
+
+def test_heal_on_crawl_queues_damaged_objects(zones, tmp_path):
+    """Full sweeps probe shard health and feed the heal hook
+    (the data scanner's healObject path)."""
+    import shutil
+
+    tracker = ut.DataUpdateTracker(m=2**14)
+    ut.install_tracker(tracker)
+    healed = []
+    meta = BucketMetadataSys(zones, cache_ttl_s=0)
+    crawler = DataCrawler(
+        zones, meta, sleep_every=0, tracker=tracker,
+        heal_hook=lambda b, o, v="": healed.append((b, o)),
+    )
+    zones.put_object("hot", "ok", io.BytesIO(b"x" * 3000), 3000)
+    zones.put_object("hot", "hurt", io.BytesIO(b"y" * 3000), 3000)
+    # wipe one disk's copy of 'hurt' only
+    root = tmp_path / "d1"
+    shutil.rmtree(root / "hot" / "hurt", ignore_errors=True)
+    crawler.crawl_once()  # first sweep probes (cycles==0 start)
+    assert ("hot", "hurt") in healed
+    assert ("hot", "ok") not in healed
+    # non-heal sweeps skip the probe
+    healed.clear()
+    zones.put_object("hot", "new", io.BytesIO(b"z"), 1)
+    crawler.crawl_once()
+    assert healed == []
