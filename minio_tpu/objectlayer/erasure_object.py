@@ -1140,13 +1140,25 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         heal-on-crawl pass: per-disk xl.meta quorum compare, NO
         namespace lock, NO shard reads, NO heal_bucket fan-out - a
         full sweep must not serialize against live traffic.  A racy
-        false positive only queues a heal that then finds nothing."""
+        false positive only queues a heal that then finds nothing.
+
+        ObjectNotFound/VersionNotFound propagate (cleanly absent,
+        e.g. deleted mid-sweep); an object damaged PAST read quorum
+        reports every disk outdated - those are the most urgent
+        heals, not exceptions to swallow."""
+        out = {"bucket": bucket, "object": object_name}
+        try:
+            fi, fis = self._read_quorum_fileinfo(
+                bucket, object_name, version_id
+            )
+        except ReadQuorumError:
+            return {
+                **out,
+                "outdated": list(range(len(self.disks))),
+                "no_quorum": True,
+            }
         disks = self._online_disks()
-        fis, _errs = read_all_fileinfo(
-            disks, bucket, object_name, version_id
-        )
-        fi = find_fileinfo_in_quorum(fis, self.read_quorum)
-        outdated = [
+        out["outdated"] = [
             i
             for i, (d, f) in enumerate(zip(disks, fis))
             if d is not None
@@ -1156,11 +1168,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 or f.data_dir != fi.data_dir
             )
         ]
-        return {
-            "bucket": bucket,
-            "object": object_name,
-            "outdated": outdated,
-        }
+        return out
 
     def heal_object(
         self, bucket, object_name, version_id="", dry_run=False

@@ -273,8 +273,18 @@ class ErasureZones(ObjectLayer):
         return z.heal_object(bucket, object_name, version_id, dry_run)
 
     def probe_object_health(self, bucket, object_name, version_id=""):
-        z = self._find_zone(bucket, object_name, version_id)
-        return z.probe_object_health(bucket, object_name, version_id)
+        # probe zones directly: routing via get_object_info would
+        # itself fail on the damaged (below-quorum) objects the probe
+        # exists to find
+        last: Exception = api.ObjectNotFound(f"{bucket}/{object_name}")
+        for z in self.zones:
+            try:
+                return z.probe_object_health(
+                    bucket, object_name, version_id
+                )
+            except (api.ObjectNotFound, api.VersionNotFound) as e:
+                last = e
+        raise last
 
     def heal_bucket(self, bucket, dry_run=False):
         healed = []

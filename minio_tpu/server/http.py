@@ -591,8 +591,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             from . import web as webmod
 
-            if path == webmod.RPC_PATH or path.startswith(
-                webmod.WEB_PREFIX + "/"
+            if (
+                path == webmod.RPC_PATH
+                or path == webmod.CONSOLE_PATH
+                or path.startswith(webmod.WEB_PREFIX + "/")
             ):
                 # web plane: JWT-authenticated (not SigV4), its own
                 # error envelope (web-router.go)

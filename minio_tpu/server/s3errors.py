@@ -260,5 +260,9 @@ def from_exception(e: Exception) -> APIError:
     ]
     for cls, code in mapping:
         if isinstance(e, cls):
+            if code in ("SlowDown", "OperationTimedOut"):
+                # quorum/lock failures carry the per-disk cause; an
+                # operator debugging a 503 needs it in the body
+                return get(code, f"{_E[code][0]} ({e})")
             return get(code)
     return get("InternalError", f"{type(e).__name__}: {e}")

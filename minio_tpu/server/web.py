@@ -366,8 +366,21 @@ def _download(h, bucket: str, obj: str, query) -> None:
         h._resp_bytes += info.size
 
 
+CONSOLE_PATH = "/minio-tpu/console"
+
+
 def handle(h, path: str, query) -> None:
     """Entry from the router for RPC_PATH / WEB_PREFIX paths."""
+    if path == CONSOLE_PATH:
+        # the embedded browser frontend (static, unauthenticated -
+        # every action it performs authenticates via web.Login)
+        if h.command != "GET":
+            raise S3Error("MethodNotAllowed")
+        from .console_ui import CONSOLE_HTML
+
+        return h._respond(
+            200, CONSOLE_HTML, content_type="text/html; charset=utf-8"
+        )
     if path == RPC_PATH:
         if h.command != "POST":
             raise S3Error("MethodNotAllowed")

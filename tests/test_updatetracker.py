@@ -327,3 +327,35 @@ def test_heal_on_crawl_queues_damaged_objects(zones, tmp_path):
     zones.put_object("hot", "new", io.BytesIO(b"z"), 1)
     crawler.crawl_once()
     assert healed == []
+
+
+def test_probe_reports_no_quorum_as_damaged(zones, tmp_path):
+    """Objects damaged past read quorum are the MOST urgent heals;
+    the probe must queue them, not skip them (review r4)."""
+    import shutil
+
+    tracker = ut.DataUpdateTracker(m=2**14)
+    ut.install_tracker(tracker)
+    healed = []
+    meta = BucketMetadataSys(zones, cache_ttl_s=0)
+    crawler = DataCrawler(
+        zones, meta, sleep_every=0, tracker=tracker,
+        heal_hook=lambda b, o, v="": healed.append((b, o)),
+    )
+    zones.put_object("hot", "wreck", io.BytesIO(b"w" * 3000), 3000)
+    # desynchronize 3 of 4 disks' journals (a torn overwrite): no
+    # (mod_time, data_dir) group reaches read quorum
+    for n, d in enumerate(zones.zones[0].sets[0].disks):
+        if n == 0:
+            continue
+        for fi in d.read_xl("hot", "wreck").versions:
+            fi.mod_time_ns += n  # each disk disagrees differently
+            d.write_metadata("hot", "wreck", fi)
+    res = zones.probe_object_health("hot", "wreck")
+    assert res.get("no_quorum") is True
+    assert len(res["outdated"]) == 4
+    # a cleanly absent object still raises (deleted mid-sweep)
+    from minio_tpu.objectlayer.api import ObjectNotFound
+
+    with pytest.raises(ObjectNotFound):
+        zones.probe_object_health("hot", "never-existed")

@@ -272,3 +272,14 @@ def test_download_filename_sanitized(server, tmp_path):
     assert st == 200 and body == b"data"
     assert "Set-Cookie" not in h
     assert "\r" not in h.get("Content-Disposition", "")
+
+
+def test_console_page_served(server):
+    st, h, body = _raw(server, "GET", "/minio-tpu/console")
+    assert st == 200
+    assert "text/html" in h.get("Content-Type", "")
+    assert b"minio-tpu console" in body
+    assert b"web.Login" in body  # drives the RPC plane
+    # anonymous: the page itself carries no data and POST is refused
+    st, _h, _b = _raw(server, "POST", "/minio-tpu/console")
+    assert st == 405
