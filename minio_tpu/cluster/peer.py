@@ -185,6 +185,15 @@ class PeerRESTServer:
         data = self.s3.profiler.stop(_q1(q, "type") or "cpu")
         return {"profile": data}
 
+    def _health_info(self, q, body) -> dict:
+        """This node's OBD document (the ServerOBDInfo peer RPC)."""
+        from ..server.admin import AdminAPI
+
+        ol = self.s3.object_layer
+        if ol is None:
+            return {"endpoint": "", "state": "initializing"}
+        return AdminAPI(self.s3)._health_info_local(ol)
+
     def _cycle_bloom(self, q, body) -> dict:
         """Rotate this node's data-update tracker and return its
         filter for [oldest, current) (the CycleServerBloomFilter peer
@@ -223,6 +232,7 @@ class PeerRESTServer:
         "consolebuf": _console_buf,
         "startprofiling": _start_profiling,
         "downloadprofiling": _download_profiling,
+        "healthinfo": _health_info,
         "cyclebloom": _cycle_bloom,
         "verifyconfig": _verify_config,
     }

@@ -146,6 +146,25 @@ class AdminAPI:
             except kmsmod.KMSError as e:
                 status["decryption"] = f"failed: {e}"
             return 200, _json(status)
+        # cluster health diagnostics (admin-handlers.go:1007
+        # OBDInfoHandler): system + per-drive microbenchmarks, every
+        # node, one JSON document
+        if route == ("GET", "healthinfo"):
+            doc = {"nodes": [self._health_info_local(ol)]}
+            peers = getattr(self.s3, "peer_notifier", None)
+            if peers is not None:
+                # concurrent gather, no retry: wall time is ONE
+                # node's probe, and a dead peer costs one timeout
+                doc["nodes"].extend(
+                    peers._gather(
+                        lambda c: c.call("healthinfo", retry=False),
+                        lambda c: {
+                            "endpoint": f"{c.host}:{c.port}",
+                            "state": "offline",
+                        },
+                    )
+                )
+            return 200, _json(doc)
         if route == ("GET", "datausage"):
             crawler = getattr(self.s3, "crawler", None)
             if crawler is None:
@@ -320,6 +339,61 @@ class AdminAPI:
         raise S3Error("MethodNotAllowed", f"admin {method} /{tail}")
 
     # -- handlers ---------------------------------------------------------
+
+    def _health_info_local(self, ol) -> dict:
+        """This node's OBD document: platform + memory + per-local-
+        drive latency/throughput microprobe (the reference's
+        getLocalDrivesOBD 4 MiB probe, obdinfo.go)."""
+        import os as _os
+        import platform
+
+        doc = {
+            "endpoint": getattr(self.s3, "endpoint", ""),
+            "state": "online",
+            "version": VERSION,
+            "uptime_seconds": round(time.time() - _START, 1),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+            "cpus": _os.cpu_count(),
+        }
+        try:
+            page = _os.sysconf("SC_PAGE_SIZE")
+            doc["mem_total_bytes"] = page * _os.sysconf("SC_PHYS_PAGES")
+            doc["mem_available_bytes"] = page * _os.sysconf(
+                "SC_AVPHYS_PAGES"
+            )
+        except (ValueError, OSError, AttributeError):
+            pass
+        drives = []
+        from .metrics import _iter_disks
+
+        probe = b"\0" * (4 << 20)
+        for d in _iter_disks(ol):
+            if d is None or not getattr(d, "is_local", lambda: False)():
+                continue
+            entry = {"endpoint": ""}
+            try:
+                info = d.disk_info()
+                entry.update(
+                    endpoint=info.endpoint,
+                    total=info.total,
+                    free=info.free,
+                )
+                t0 = time.monotonic()
+                d.write_all(".sys", "tmp/obd-probe", probe)
+                t1 = time.monotonic()
+                d.read_all(".sys", "tmp/obd-probe")
+                t2 = time.monotonic()
+                d.delete_file(".sys", "tmp/obd-probe")
+                entry["write_mibps"] = round(4 / max(t1 - t0, 1e-9), 1)
+                entry["read_mibps"] = round(4 / max(t2 - t1, 1e-9), 1)
+                entry["latency_ms"] = round((t1 - t0) * 1e3, 2)
+                entry["state"] = "ok"
+            except Exception as e:  # noqa: BLE001
+                entry["state"] = f"error: {type(e).__name__}"
+            drives.append(entry)
+        doc["drives"] = drives
+        return doc
 
     def _info(self, ol) -> bytes:
         si = ol.storage_info()

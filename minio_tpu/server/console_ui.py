@@ -88,8 +88,20 @@ CONSOLE_HTML = b"""<!doctype html>
 let token = sessionStorage.getItem("mt-token") || "";
 let bucket = "", prefix = "";
 const $ = id => document.getElementById(id);
-const esc = s => { const d = document.createElement("div");
-                   d.textContent = s; return d.innerHTML; };
+// rows are built with DOM APIs + addEventListener, never by
+// interpolating names into HTML/JS strings: object keys are
+// user-controlled and must stay inert text
+function el(tag, text) {
+  const e = document.createElement(tag);
+  if (text !== undefined) e.textContent = text;
+  return e;
+}
+function actionLink(label, fn, cls) {
+  const b = el(cls === "link" ? "a" : "button", label);
+  if (cls && cls !== "link") b.className = cls;
+  b.addEventListener("click", fn);
+  return b;
+}
 
 async function rpc(method, params) {
   const headers = {"Content-Type": "application/json"};
@@ -128,13 +140,24 @@ async function show() {
 async function listBuckets() {
   try {
     const res = await rpc("web.ListBuckets");
-    $("buckets").innerHTML = res.buckets.map(b =>
-      `<tr><td><a onclick="openBucket('${esc(b.name)}')">` +
-      `${esc(b.name)}</a></td>` +
-      `<td style="text-align:right"><button class="danger" ` +
-      `onclick="dropBucket('${esc(b.name)}')">delete</button>` +
-      `</td></tr>`).join("") ||
-      "<tr><td>no buckets</td></tr>";
+    const tbody = $("buckets");
+    tbody.replaceChildren();
+    if (!res.buckets.length) {
+      const tr = el("tr");
+      tr.append(el("td", "no buckets"));
+      tbody.append(tr);
+    }
+    for (const b of res.buckets) {
+      const tr = el("tr");
+      const td1 = el("td");
+      td1.append(actionLink(b.name, () => openBucket(b.name), "link"));
+      const td2 = el("td");
+      td2.style.textAlign = "right";
+      td2.append(actionLink("delete", () => dropBucket(b.name),
+                            "danger"));
+      tr.append(td1, td2);
+      tbody.append(tr);
+    }
     ok();
   } catch (e) { fail(e); }
 }
@@ -159,15 +182,32 @@ async function openBucket(name, pfx) {
                           {bucketName: bucket, prefix});
     $("objects-card").classList.remove("hidden");
     $("crumb").textContent = bucket + "/" + prefix;
-    $("objects").innerHTML = res.objects.map(o => o.isDir
-      ? `<tr><td><a onclick="openBucket('${esc(bucket)}',` +
-        `'${esc(o.name)}')">${esc(o.name)}</a></td><td></td><td></td></tr>`
-      : `<tr><td>${esc(o.name)}</td><td>${o.size}</td>` +
-        `<td style="text-align:right">` +
-        `<a onclick="download('${esc(o.name)}')">download</a> ` +
-        `<button class="danger" onclick="removeObj('${esc(o.name)}')">` +
-        `delete</button></td></tr>`).join("") ||
-      "<tr><td>empty</td></tr>";
+    const tbody = $("objects");
+    tbody.replaceChildren();
+    if (!res.objects.length) {
+      const tr = el("tr");
+      tr.append(el("td", "empty"));
+      tbody.append(tr);
+    }
+    for (const o of res.objects) {
+      const tr = el("tr");
+      if (o.isDir) {
+        const td = el("td");
+        td.append(actionLink(o.name,
+          () => openBucket(bucket, o.name), "link"));
+        tr.append(td, el("td"), el("td"));
+      } else {
+        const td3 = el("td");
+        td3.style.textAlign = "right";
+        td3.append(actionLink("download", () => download(o.name),
+                              "link"));
+        td3.append(document.createTextNode(" "));
+        td3.append(actionLink("delete", () => removeObj(o.name),
+                              "danger"));
+        tr.append(el("td", o.name), el("td", String(o.size)), td3);
+      }
+      tbody.append(tr);
+    }
     ok();
   } catch (e) { fail(e); }
 }
@@ -190,8 +230,11 @@ async function upload() {
   const f = $("file").files[0];
   if (!f) { fail(new Error("choose a file first")); return; }
   try {
-    const r = await fetch("/minio-tpu/web/upload/" + bucket + "/" +
-        prefix + encodeURIComponent(f.name), {
+    const encPrefix = prefix.split("/").map(
+      encodeURIComponent).join("/");
+    const r = await fetch("/minio-tpu/web/upload/" +
+        encodeURIComponent(bucket) + "/" +
+        encPrefix + encodeURIComponent(f.name), {
       method: "PUT",
       headers: {"Authorization": "Bearer " + token,
                 "Content-Type": f.type || "application/octet-stream"},

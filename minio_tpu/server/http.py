@@ -20,6 +20,7 @@ import email.utils
 import hashlib
 import io
 import os
+import re
 import socket
 import threading
 import time as _time
@@ -2602,9 +2603,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _put_part(self, bucket, key, query):
         if "x-amz-copy-source" in self.headers:
-            # UploadPartCopy: storing the (empty) request body as the
-            # part would corrupt the upload - refuse until implemented
-            raise S3Error("NotImplemented", "UploadPartCopy")
+            return self._upload_part_copy(bucket, key, query)
         uid = query["uploadId"][0]
         try:
             pnum = int(query["partNumber"][0])
@@ -2626,6 +2625,67 @@ class _Handler(BaseHTTPRequestHandler):
             bucket, key, uid, pnum, hreader, size, part_sse
         )
         self._respond(200, b"", {"ETag": f'"{pi.etag}"'})
+
+    def _upload_part_copy(self, bucket, key, query):
+        """UploadPartCopy (CopyObjectPartHandler,
+        object-handlers.go:795): stream a source object (or byte
+        range of it) in as one part - decrypt with the copy-source
+        key, re-encrypt under the upload's regime."""
+        from ..utils.hashreader import HashReader
+        from ..utils.pipe import streaming_copy
+
+        uid = query["uploadId"][0]
+        try:
+            pnum = int(query["partNumber"][0])
+        except (KeyError, ValueError):
+            raise S3Error("InvalidArgument", "partNumber") from None
+        src_bucket, src_key = self._parse_copy_source()
+        ol = self.s3.object_layer
+        src_info = ol.get_object_info(src_bucket, src_key)
+        sse_src = self._read_sse(src_info, copy_source=True)
+        part_sse = self._parse_ssec_headers(
+            "x-amz-server-side-encryption-customer"
+        )
+        offset, length = 0, -1
+        rng = self.headers.get("x-amz-copy-source-range")
+        if rng:
+            # strict "bytes=a-b" (ErrInvalidCopyPartRange): open-ended
+            # and suffix forms are NOT valid here, unlike GET ranges
+            m = re.fullmatch(r"bytes=(\d+)-(\d+)", rng.strip())
+            if not m:
+                raise S3Error(
+                    "InvalidArgument",
+                    "The x-amz-copy-source-range value must be of the "
+                    "form bytes=first-last where first and last are "
+                    "the zero-based offsets of the first and last "
+                    "bytes to copy",
+                )
+            lo, hi = int(m.group(1)), int(m.group(2))
+            if lo > hi or hi >= src_info.size:
+                raise S3Error(
+                    "InvalidArgument",
+                    f"Range specified is not valid for source object "
+                    f"of size: {src_info.size}",
+                )
+            offset, length = lo, hi - lo + 1
+        size = length if length >= 0 else src_info.size
+        if size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        from ..objectlayer import quota as quotamod
+
+        quotamod.enforce_put(self.s3, bucket, size)
+        pi = streaming_copy(
+            lambda sink: ol.get_object(
+                src_bucket, src_key, sink, offset, length, "", sse_src
+            ),
+            lambda source: ol.put_object_part(
+                bucket, key, uid, pnum,
+                HashReader(source, size), size, part_sse,
+            ),
+        )
+        self._respond(
+            200, xmlr.copy_part_xml(pi.etag, pi.mod_time_ns)
+        )
 
     def _complete_multipart(self, bucket, key, query, body):
         uid = query["uploadId"][0]

@@ -189,6 +189,14 @@ def copy_object_xml(etag, mod_time_ns) -> bytes:
     return _render(root)
 
 
+def copy_part_xml(etag, mod_time_ns) -> bytes:
+    """UploadPartCopy response (CopyObjectPartResponse)."""
+    root = ET.Element("CopyPartResult", xmlns=S3_NS)
+    _el(root, "LastModified", _iso(mod_time_ns))
+    _el(root, "ETag", f'"{etag}"')
+    return _render(root)
+
+
 def delete_result_xml(deleted: list[str], errors: list[tuple]) -> bytes:
     root = ET.Element("DeleteResult", xmlns=S3_NS)
     for key in deleted:

@@ -30,7 +30,11 @@ def handle_select(handler, bucket, key, info, body) -> None:
     with tempfile.SpooledTemporaryFile(max_size=SPOOL_MEM) as spool, \
             tempfile.SpooledTemporaryFile(max_size=SPOOL_MEM) as out:
         # full-object read through the erasure/SSE/compression stack
-        handler.s3.object_layer.get_object(bucket, key, spool)
+        # SSE-C objects are selectable with their key (the reference
+        # routes select reads through getObjectNInfo, which decrypts)
+        handler.s3.object_layer.get_object(
+            bucket, key, spool, sse=handler._read_sse(info)
+        )
         spool.seek(0)
         try:
             # result frames spool too: a huge SELECT * result must not

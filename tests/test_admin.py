@@ -201,3 +201,21 @@ def test_structured_log_shape(capsys):
     assert doc["msg"] == "hello"
     assert doc["bucket"] == "bk" and doc["n"] == 3
     assert doc["level"] == "info"
+
+
+def test_admin_healthinfo(server, root_client):
+    """OBD diagnostics: platform + per-drive microprobe
+    (admin-handlers.go OBDInfoHandler)."""
+    r = root_client.request("GET", f"{ADMIN}/healthinfo")
+    assert r.status == 200, r.body
+    doc = json.loads(r.body)
+    node = doc["nodes"][0]
+    assert node["state"] == "online"
+    assert node["cpus"] >= 1
+    assert node["mem_total_bytes"] > 0
+    drives = node["drives"]
+    assert len(drives) == 4
+    for d in drives:
+        assert d["state"] == "ok"
+        assert d["write_mibps"] > 0 and d["read_mibps"] > 0
+        assert d["total"] > 0

@@ -498,8 +498,9 @@ def test_copy_source_authz_not_bypassed_by_partnumber(
     assert r.status == 403
 
 
-def test_upload_part_copy_not_implemented(iam_server, root_client):
-    """UploadPartCopy must refuse rather than store the empty body."""
+def test_upload_part_copy_authorizes_source(iam_server, root_client):
+    """UploadPartCopy reads the copy source, so source read access is
+    enforced like CopyObject."""
     c = root_client
     r = c.request("POST", "/shared/mpk", query={"uploads": ""})
     assert r.status == 200
@@ -509,7 +510,8 @@ def test_upload_part_copy_not_implemented(iam_server, root_client):
         query={"partNumber": "1", "uploadId": uid},
         headers={"x-amz-copy-source": "/shared/hello.txt"},
     )
-    assert r.status == 501
+    assert r.status == 200
+    assert b"CopyPartResult" in r.body
     c.request("DELETE", "/shared/mpk", query={"uploadId": uid})
 
 

@@ -112,3 +112,73 @@ def test_part_etag_mismatch(ol):
         ol.complete_multipart_upload(
             "bucket", "obj", uid, [CompletePart(1, "0" * 32)]
         )
+
+
+def test_upload_part_copy_e2e(tmp_path):
+    """UploadPartCopy through the server: whole-object and ranged
+    source parts assemble into the destination object."""
+    import os as _os
+    import sys
+
+    sys.path.insert(0, "tests")
+    from s3client import S3Client
+    from minio_tpu.server.http import S3Server
+
+    disks = [XLStorage(str(tmp_path / f"sv{i}")) for i in range(4)]
+    layer = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    srv = S3Server(layer, address="127.0.0.1:0").start()
+    try:
+        c = S3Client(srv.endpoint)
+        assert c.make_bucket("upc").status == 200
+        src = _os.urandom(6 << 20)
+        assert c.put_object("upc", "src.bin", src).status == 200
+        r = c.request("POST", "/upc/dst.bin", query={"uploads": ""})
+        uid = r.xml_text("UploadId")
+        # part 1: whole source object
+        r = c.request(
+            "PUT", "/upc/dst.bin",
+            query={"partNumber": "1", "uploadId": uid},
+            headers={"x-amz-copy-source": "/upc/src.bin"},
+        )
+        assert r.status == 200, r.body
+        etag1 = r.xml_text("ETag").strip('"')
+        # part 2: a byte range of the source
+        r = c.request(
+            "PUT", "/upc/dst.bin",
+            query={"partNumber": "2", "uploadId": uid},
+            headers={
+                "x-amz-copy-source": "/upc/src.bin",
+                "x-amz-copy-source-range": "bytes=100-1099",
+            },
+        )
+        assert r.status == 200, r.body
+        etag2 = r.xml_text("ETag").strip('"')
+        done = (
+            f"<CompleteMultipartUpload>"
+            f"<Part><PartNumber>1</PartNumber><ETag>{etag1}</ETag></Part>"
+            f"<Part><PartNumber>2</PartNumber><ETag>{etag2}</ETag></Part>"
+            f"</CompleteMultipartUpload>"
+        ).encode()
+        r = c.request(
+            "POST", "/upc/dst.bin", query={"uploadId": uid}, body=done
+        )
+        assert r.status == 200, r.body
+        got = c.get_object("upc", "dst.bin")
+        assert got.status == 200
+        assert got.body == src + src[100:1100]
+        # malformed/out-of-bounds ranges are refused
+        uid2 = c.request(
+            "POST", "/upc/d2", query={"uploads": ""}
+        ).xml_text("UploadId")
+        for bad in ("bytes=5-", "bytes=9-2", f"bytes=0-{len(src)}"):
+            r = c.request(
+                "PUT", "/upc/d2",
+                query={"partNumber": "1", "uploadId": uid2},
+                headers={
+                    "x-amz-copy-source": "/upc/src.bin",
+                    "x-amz-copy-source-range": bad,
+                },
+            )
+            assert r.status == 400, (bad, r.status)
+    finally:
+        srv.shutdown()

@@ -513,3 +513,35 @@ def test_part_key_on_unencrypted_upload_rejected(tls_server):
         body=b"part-data", headers=_ssec_headers(),
     )
     assert r.status == 403, (r.status, r.body)
+
+
+def test_select_over_ssec_object(tls_server):
+    """SelectObjectContent decrypts SSE-C objects when the key rides
+    the request; refuses without it."""
+    c = S3Client(tls_server.endpoint)
+    csv = b"name,qty\napple,3\npear,7\n"
+    assert c.request(
+        "PUT", "/bkt/sel.csv", body=csv, headers=_ssec_headers()
+    ).status == 200
+    sel = (
+        b"<SelectObjectContentRequest><Expression>"
+        b"SELECT qty FROM S3Object WHERE name = 'pear'"
+        b"</Expression><ExpressionType>SQL</ExpressionType>"
+        b"<InputSerialization><CSV><FileHeaderInfo>USE"
+        b"</FileHeaderInfo></CSV></InputSerialization>"
+        b"<OutputSerialization><CSV/></OutputSerialization>"
+        b"</SelectObjectContentRequest>"
+    )
+    r = c.request(
+        "POST", "/bkt/sel.csv",
+        query={"select": "", "select-type": "2"},
+        body=sel, headers=_ssec_headers(),
+    )
+    assert r.status == 200, r.body[:300]
+    assert b"7" in r.body
+    # without the key: refused up front, no EventStream leak
+    r = c.request(
+        "POST", "/bkt/sel.csv",
+        query={"select": "", "select-type": "2"}, body=sel,
+    )
+    assert r.status == 400, (r.status, r.body[:200])
