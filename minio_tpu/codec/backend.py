@@ -18,6 +18,7 @@ backend verify and decode under the other.
 from __future__ import annotations
 
 import os
+import subprocess
 import threading
 
 import numpy as np
@@ -191,10 +192,28 @@ class CpuBackend(CodecBackend):
             )
         return out
 
+    # None = untried, False = unavailable (decision cached: the
+    # fallback must not re-attempt a failing g++ build per block)
+    _native_hash_ok: "bool | None" = None
+
     def digest(self, shards):
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
         L = shards.shape[-1]
         words = shards.view(np.uint32)
+        if CpuBackend._native_hash_ok is not False:
+            from ..utils import native
+
+            try:
+                out = native.phash256_rows(words, L)
+                CpuBackend._native_hash_ok = True
+                return out
+            except (
+                OSError,
+                AttributeError,  # stale .so without the symbol
+                subprocess.CalledProcessError,
+            ):
+                CpuBackend._native_hash_ok = False
+        # no toolchain / stale lib: numpy twin (bit-identical, slower)
         return phash.phash256_host_batched(words, L)
 
 

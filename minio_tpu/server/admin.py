@@ -364,13 +364,18 @@ class AdminAPI:
             )
         except (ValueError, OSError, AttributeError):
             pass
-        drives = []
+        from concurrent.futures import ThreadPoolExecutor
+
         from .metrics import _iter_disks
 
-        probe = b"\0" * (4 << 20)
-        for d in _iter_disks(ol):
-            if d is None or not getattr(d, "is_local", lambda: False)():
-                continue
+        probe = b"\0" * (1 << 20)
+
+        def probe_drive(d) -> dict:
+            import uuid as _uuid
+
+            # unique path per request (concurrent OBD calls must not
+            # race each other's probe files) + guaranteed cleanup
+            path = f"tmp/obd-probe-{_uuid.uuid4().hex}"
             entry = {"endpoint": ""}
             try:
                 info = d.disk_info()
@@ -380,19 +385,39 @@ class AdminAPI:
                     free=info.free,
                 )
                 t0 = time.monotonic()
-                d.write_all(".sys", "tmp/obd-probe", probe)
+                d.write_all(".sys", path, probe)
                 t1 = time.monotonic()
-                d.read_all(".sys", "tmp/obd-probe")
-                t2 = time.monotonic()
-                d.delete_file(".sys", "tmp/obd-probe")
-                entry["write_mibps"] = round(4 / max(t1 - t0, 1e-9), 1)
-                entry["read_mibps"] = round(4 / max(t2 - t1, 1e-9), 1)
+                try:
+                    d.read_all(".sys", path)
+                    t2 = time.monotonic()
+                finally:
+                    try:
+                        d.delete_file(".sys", path)
+                    except Exception:  # noqa: BLE001
+                        pass
+                entry["write_mibps"] = round(1 / max(t1 - t0, 1e-9), 1)
+                entry["read_mibps"] = round(1 / max(t2 - t1, 1e-9), 1)
                 entry["latency_ms"] = round((t1 - t0) * 1e3, 2)
                 entry["state"] = "ok"
             except Exception as e:  # noqa: BLE001
                 entry["state"] = f"error: {type(e).__name__}"
-            drives.append(entry)
-        doc["drives"] = drives
+            return entry
+
+        local = [
+            d
+            for d in _iter_disks(ol)
+            if d is not None
+            and getattr(d, "is_local", lambda: False)()
+        ]
+        # concurrent probes: a many-drive node must answer inside the
+        # peer RPC timeout, and wall time is one drive's probe
+        if local:
+            with ThreadPoolExecutor(
+                max_workers=min(8, len(local))
+            ) as pool:
+                doc["drives"] = list(pool.map(probe_drive, local))
+        else:
+            doc["drives"] = []
         return doc
 
     def _info(self, ol) -> bytes:

@@ -51,6 +51,11 @@ def lib() -> ctypes.CDLL:
             ]
             l.gf_matmul.restype = None
             l.gf_has_avx2.restype = ctypes.c_int
+            l.phash256_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+                ctypes.c_uint64, ctypes.c_void_p,
+            ]
+            l.phash256_rows.restype = None
             _lib = l
     return _lib
 
@@ -103,3 +108,24 @@ def reconstruct_cpu(
 
 def has_avx2() -> bool:
     return bool(lib().gf_has_avx2())
+
+
+def phash256_rows(words: np.ndarray, nbytes: int) -> np.ndarray:
+    """Native phash256 over rows: (..., w) uint32 -> (..., 8) uint32.
+
+    Bit-identical AVX2 twin of ops/hash.py phash256_host_batched; the
+    hash dominated the CPU-codec e2e path in profiling (the encode
+    itself is native already)."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    lead = words.shape[:-1]
+    n = words.shape[-1]
+    flat = words.reshape(-1, n)
+    out = np.empty((flat.shape[0], 8), dtype=np.uint32)
+    lib().phash256_rows(
+        flat.ctypes.data_as(ctypes.c_void_p),
+        flat.shape[0],
+        n,
+        nbytes,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out.reshape(*lead, 8)

@@ -96,6 +96,84 @@ void mul_acc(uint8_t c, const uint8_t* in, uint8_t* out, size_t len) {
 #endif
 }
 
+// ---------------------------------------------------------------------
+// phash256: native twin of ops/hash.py phash256_host_batched
+// (bit-identical).  Word-parallel by construction, so the AVX2 path
+// processes 8 u32 lanes per step; lane j of the accumulators folds
+// into digest partition j & 3.
+// ---------------------------------------------------------------------
+
+inline uint32_t mix32(uint32_t x) {
+  x ^= x >> 16;
+  x *= 0x85EBCA6Bu;
+  x ^= x >> 13;
+  x *= 0xC2B2AE35u;
+  x ^= x >> 16;
+  return x;
+}
+
+constexpr uint32_t kC1 = 0x9E3779B9u;
+constexpr uint32_t kM1 = 0xCC9E2D51u;
+constexpr uint32_t kM2 = 0x1B873593u;
+
+#if defined(__AVX2__)
+inline __m256i mix256(__m256i x) {
+  x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 16));
+  x = _mm256_mullo_epi32(x, _mm256_set1_epi32((int)0x85EBCA6Bu));
+  x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 13));
+  x = _mm256_mullo_epi32(x, _mm256_set1_epi32((int)0xC2B2AE35u));
+  x = _mm256_xor_si256(x, _mm256_srli_epi32(x, 16));
+  return x;
+}
+#endif
+
+void phash_row(const uint32_t* w, size_t n, uint64_t nbytes,
+               uint32_t* out8) {
+  uint32_t p1[4] = {0, 0, 0, 0}, p2[4] = {0, 0, 0, 0};
+  size_t i = 0;
+#if defined(__AVX2__)
+  if (n >= 8) {
+    __m256i acc1 = _mm256_setzero_si256();
+    __m256i acc2 = _mm256_setzero_si256();
+    const __m256i lane = _mm256_setr_epi32(0, 1, 2, 3, 4, 5, 6, 7);
+    const __m256i vc1 = _mm256_set1_epi32((int)kC1);
+    const __m256i vm1 = _mm256_set1_epi32((int)kM1);
+    const __m256i vm2 = _mm256_set1_epi32((int)kM2);
+    for (; i + 8 <= n; i += 8) {
+      __m256i idx = _mm256_add_epi32(_mm256_set1_epi32((int)i), lane);
+      __m256i key = mix256(_mm256_add_epi32(
+          _mm256_mullo_epi32(idx, vc1), _mm256_set1_epi32(1)));
+      __m256i x = _mm256_loadu_si256(
+          reinterpret_cast<const __m256i*>(w + i));
+      __m256i t1 =
+          mix256(_mm256_mullo_epi32(_mm256_xor_si256(x, key), vm1));
+      __m256i t2 =
+          mix256(_mm256_mullo_epi32(_mm256_add_epi32(x, key), vm2));
+      acc1 = _mm256_xor_si256(acc1, t1);
+      acc2 = _mm256_xor_si256(acc2, t2);
+    }
+    alignas(32) uint32_t a1[8], a2[8];
+    _mm256_store_si256(reinterpret_cast<__m256i*>(a1), acc1);
+    _mm256_store_si256(reinterpret_cast<__m256i*>(a2), acc2);
+    for (int j = 0; j < 8; ++j) {
+      p1[j & 3] ^= a1[j];
+      p2[j & 3] ^= a2[j];
+    }
+  }
+#endif
+  for (; i < n; ++i) {
+    uint32_t key = mix32((uint32_t)i * kC1 + 1u);
+    uint32_t x = w[i];
+    p1[i & 3] ^= mix32((x ^ key) * kM1);
+    p2[i & 3] ^= mix32((x + key) * kM2);
+  }
+  uint32_t lenmix = (uint32_t)(nbytes * (uint64_t)kC1);
+  for (int j = 0; j < 8; ++j) {
+    uint32_t v = j < 4 ? p1[j] : p2[j - 4];
+    out8[j] = mix32(v ^ (lenmix + (uint32_t)j));
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -115,6 +193,15 @@ void gf_matmul(int out_n, int in_n, const uint8_t* matrix,
 // Convenience single mul-acc (used by tests)
 void gf_mul_acc(uint8_t c, const uint8_t* in, uint8_t* out, size_t len) {
   mul_acc(c, in, out, len);
+}
+
+// digests[r*8..r*8+8) = phash256 of words[r*nwords..(r+1)*nwords)
+// with the real (unpadded) byte length folded in.
+void phash256_rows(const uint32_t* words, size_t nrows, size_t nwords,
+                   uint64_t nbytes, uint32_t* digests) {
+  for (size_t r = 0; r < nrows; ++r) {
+    phash_row(words + r * nwords, nwords, nbytes, digests + r * 8);
+  }
 }
 
 int gf_has_avx2(void) {

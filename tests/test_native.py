@@ -37,3 +37,20 @@ def test_reconstruct_roundtrip():
     present[[1, 4, 8, 11]] = False
     got = native.reconstruct_cpu(shards, present, k, m)
     assert np.array_equal(got, data)
+
+
+def test_native_phash_bit_identical_and_fast():
+    """AVX2 phash256 twin must match the numpy reference exactly
+    (shard files hashed by either verify under the other)."""
+    import numpy as np
+
+    from minio_tpu.ops import hash as ph
+    from minio_tpu.utils import native
+
+    rng = np.random.default_rng(11)
+    for shape in [(3, 4, 256), (12, 4096), (1, 8), (2, 4), (5, 12)]:
+        words = rng.integers(0, 2**32, shape, dtype=np.uint32)
+        for nbytes in (shape[-1] * 4, shape[-1] * 4 - 3):
+            a = native.phash256_rows(words, nbytes)
+            b = ph.phash256_host_batched(words, nbytes)
+            assert np.array_equal(a, b), (shape, nbytes)
