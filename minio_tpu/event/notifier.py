@@ -32,6 +32,15 @@ class EventNotifier:
         self._queue: "collections.deque" = collections.deque(
             maxlen=_QUEUE_MAX
         )
+        # live listeners (ListenBucketNotification): every event
+        # fans out here regardless of configured bucket rules.  The
+        # subscription is SCOPED per bucket so one watcher does not
+        # de-optimize the fast path for every other bucket
+        from ..utils.pubsub import PubSub
+
+        self.listeners = PubSub(maxlen=1000)
+        self._listener_mu = threading.Lock()
+        self._listener_counts: "dict[str, int]" = {}
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._seq = itertools.count(1)
@@ -78,9 +87,33 @@ class EventNotifier:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def subscribe_listener(self, bucket: str):
+        """Live subscription for one bucket's events."""
+        sub = self.listeners.subscribe()
+        with self._listener_mu:
+            self._listener_counts[bucket] = (
+                self._listener_counts.get(bucket, 0) + 1
+            )
+        return sub
+
+    def unsubscribe_listener(self, bucket: str, sub) -> None:
+        self.listeners.unsubscribe(sub)
+        with self._listener_mu:
+            n = self._listener_counts.get(bucket, 0) - 1
+            if n > 0:
+                self._listener_counts[bucket] = n
+            else:
+                self._listener_counts.pop(bucket, None)
+
+    def has_listeners(self, bucket: str) -> bool:
+        with self._listener_mu:
+            return bucket in self._listener_counts
+
     def send(self, event: Event) -> None:
         """Fast path: O(1) enqueue; rule matching happens off-thread."""
-        if not self.rules.has_rules(event.bucket):
+        if not self.rules.has_rules(event.bucket) and not (
+            self.has_listeners(event.bucket)
+        ):
             return
         if not event.sequencer:
             event.sequencer = f"{next(self._seq):016X}"
@@ -109,6 +142,8 @@ class EventNotifier:
             self._dispatch(ev)
 
     def _dispatch(self, ev: Event) -> None:
+        if self.has_listeners(ev.bucket):
+            self.listeners.publish(ev)
         arns = self.rules.match(ev.bucket, ev.name, ev.object_key)
         if not arns:
             return

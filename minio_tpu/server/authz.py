@@ -13,6 +13,10 @@ from ..iam.policy import Args
 from .s3errors import S3Error
 
 _BUCKET_GET_SUBRESOURCES = {
+    # FIRST: must mirror the router's dispatch precedence - a request
+    # carrying several sub-resources is authorized for the one that
+    # will actually serve it, and the router checks ?events first
+    "events": "s3:ListenBucketNotification",
     "location": "s3:GetBucketLocation",
     "policy": "s3:GetBucketPolicy",
     "versioning": "s3:GetBucketVersioning",

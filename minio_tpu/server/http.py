@@ -189,6 +189,9 @@ class S3Server:
         # concurrent S3 requests; excess waits up to the deadline then
         # gets 503.  0 = unlimited.
         self._inflight = 0
+        # set at shutdown: long-lived streams (listen notifications)
+        # must end so the drain window isn't spent waiting on them
+        self.draining = False
         self._adm_mu = threading.Lock()
         self._adm_cv = threading.Condition(self._adm_mu)
         # internode planes (storage/lock/peer/bootstrap REST, the
@@ -334,6 +337,7 @@ class S3Server:
         """Stop accepting, then drain in-flight requests up to
         ``drain_s`` (the reference's graceful shutdown,
         cmd/http/server.go:116 request draining)."""
+        self.draining = True
         if self._httpd:
             self._httpd.shutdown()  # stop accepting new connections
         deadline = _time.monotonic() + drain_s
@@ -1088,6 +1092,8 @@ class _Handler(BaseHTTPRequestHandler):
 
         # bucket-level
         if m == "GET":
+            if "events" in query:
+                return self._listen_notification(bucket, query)
             if "location" in query:
                 return self._respond(200, xmlr.location_xml(""))
             if "policy" in query:
@@ -1339,6 +1345,80 @@ class _Handler(BaseHTTPRequestHandler):
         self._respond(200, headers={"Location": f"/{bucket}"})
 
     # -- service ----------------------------------------------------------
+
+    def _listen_notification(self, bucket: str, query) -> None:
+        """ListenBucketNotification (listen-notification-handlers.go):
+        stream matching events to the client as JSON lines with
+        whitespace keep-alives, until it disconnects.
+
+        Events observed are the ones THIS node generates; in a
+        multi-node deployment a watcher sees its node's writes (the
+        reference fans the subscription out over its peer Listen RPC
+        - a noted gap here, exact on single-node).
+        """
+        import json as _json
+
+        from ..event.event import EventName
+
+        self.s3.object_layer.get_bucket_info(bucket)
+        prefix = query.get("prefix", [""])[0]
+        suffix = query.get("suffix", [""])[0]
+        names: "set[str]" = set()
+        for raw in query.get("events", [""]):
+            for part in raw.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if not EventName.valid(part):
+                    raise S3Error(
+                        "InvalidArgument", f"unknown event {part!r}"
+                    )
+                names.update(EventName.expand(part))
+        self._finish_body()
+        sub = self.s3.events.subscribe_listener(bucket)
+        self.send_response(200)
+        self.send_header("Server", "MinIO-TPU")
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Connection", "close")
+        self.close_connection = True
+        self.end_headers()
+        self._last_status = 200
+        last_keepalive = _time.monotonic()
+        try:
+            while not self.s3.draining:
+                ev = sub.get(timeout=0.5)
+                now = _time.monotonic()
+                # keep-alive on EVERY idle-enough iteration: a steady
+                # stream of filtered-out events must not starve the
+                # client of bytes (proxies kill silent connections)
+                if now - last_keepalive >= 5.0:
+                    self.wfile.write(b" ")
+                    self.wfile.flush()
+                    last_keepalive = now
+                if ev is None:
+                    continue
+                if ev.bucket != bucket:
+                    continue
+                if names and ev.name not in names:
+                    continue
+                key = ev.object_key
+                if not (key.startswith(prefix) and key.endswith(suffix)):
+                    continue
+                line = _json.dumps(
+                    {
+                        "EventName": ev.name,
+                        "Key": f"{ev.bucket}/{key}",
+                        "Records": [ev.to_record()],
+                    }
+                ).encode() + b"\n"
+                self.wfile.write(line)
+                self.wfile.flush()
+                self._resp_bytes += len(line)
+                last_keepalive = now
+        except OSError:
+            pass  # client went away: the normal way this ends
+        finally:
+            self.s3.events.unsubscribe_listener(bucket, sub)
 
     def _list_buckets(self):
         buckets = self.s3.object_layer.list_buckets()
@@ -1862,10 +1942,14 @@ class _Handler(BaseHTTPRequestHandler):
         self, name, bucket, key, etag="", size=0, version_id=""
     ) -> None:
         """Queue a bucket event (sendEvent, cmd/notification.go) -
-        O(1) when the bucket has no notification rules."""
+        O(1) when the bucket has no notification rules AND nobody is
+        listening (live ListenBucketNotification streams receive
+        events regardless of configured rules)."""
         s3 = self.s3
         s3.ensure_event_rules(bucket)
-        if not s3.events.rules.has_rules(bucket):
+        if not s3.events.rules.has_rules(bucket) and not (
+            s3.events.has_listeners(bucket)
+        ):
             return
         from ..event import Event, Identity
 
