@@ -185,6 +185,24 @@ class PeerRESTServer:
         data = self.s3.profiler.stop(_q1(q, "type") or "cpu")
         return {"profile": data}
 
+    def _bg_heal_status(self, q, body) -> dict:
+        """This node's background-heal counters (the
+        BackgroundHealStatus peer RPC)."""
+        from ..server.admin import AdminAPI
+
+        return AdminAPI(self.s3)._bg_heal_local()
+
+    def _signal_service(self, q, body) -> dict:
+        """Stop/restart THIS node (the SignalService peer RPC,
+        peer-rest-client.go SignalService)."""
+        from ..server.admin import AdminAPI
+
+        action = _q1(q, "action")
+        if action not in ("stop", "restart"):
+            return {"ok": False, "error": f"bad action {action!r}"}
+        AdminAPI(self.s3)._signal_self(action)
+        return {"ok": True}
+
     def _health_info(self, q, body) -> dict:
         """This node's OBD document (the ServerOBDInfo peer RPC)."""
         from ..server.admin import AdminAPI
@@ -233,6 +251,8 @@ class PeerRESTServer:
         "startprofiling": _start_profiling,
         "downloadprofiling": _download_profiling,
         "healthinfo": _health_info,
+        "bghealstatus": _bg_heal_status,
+        "signalservice": _signal_service,
         "cyclebloom": _cycle_bloom,
         "verifyconfig": _verify_config,
     }

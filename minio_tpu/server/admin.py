@@ -46,6 +46,49 @@ class AdminAPI:
             return 200, _json(ol.storage_info())
         if route == ("POST", "heal"):
             return 200, self._heal(ol, q)
+        # aggregate MRF/background-heal state, every node
+        # (getAggregatedBackgroundHealState, admin-heal-ops.go)
+        if route == ("GET", "background-heal/status"):
+            doc = {"nodes": [self._bg_heal_local()]}
+            peers = getattr(self.s3, "peer_notifier", None)
+            if peers is not None:
+                doc["nodes"].extend(
+                    peers._gather(
+                        lambda c: c.call(
+                            "bghealstatus", retry=False
+                        ),
+                        lambda c: {
+                            "endpoint": f"{c.host}:{c.port}",
+                            "state": "offline",
+                        },
+                    )
+                )
+            return 200, _json(doc)
+        # service control (ServiceHandler, admin-handlers.go:192):
+        # stop/restart THIS node, fanned out to peers first
+        if route == ("POST", "service"):
+            action = q.get("action", "")
+            if action not in ("stop", "restart"):
+                raise S3Error(
+                    "InvalidArgument",
+                    "action must be stop or restart",
+                )
+            peers = getattr(self.s3, "peer_notifier", None)
+            signalled = []
+            if peers is not None:
+                for c in peers.clients:
+                    try:
+                        c.call(
+                            "signalservice", {"action": action},
+                            retry=False,
+                        )
+                        signalled.append(f"{c.host}:{c.port}")
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._signal_self(action)
+            return 200, _json(
+                {"action": action, "peers_signalled": signalled}
+            )
         # resumable heal sequences with client tokens
         # (admin-heal-ops.go LaunchNewHealSequence/PopHealStatusJSON)
         if route == ("POST", "heal-sequence"):
@@ -469,6 +512,41 @@ class AdminAPI:
             for node_locks in notifier.all_locks():
                 locks.extend(node_locks)
         return _json({"locks": locks})
+
+    def _bg_heal_local(self) -> dict:
+        routine = getattr(self.s3, "heal_routine", None)
+        queue = getattr(self.s3, "heal_queue", None)
+        return {
+            "endpoint": getattr(self.s3, "endpoint", ""),
+            "state": "online",
+            "enabled": routine is not None,
+            "queued": len(queue) if queue is not None else 0,
+            "healed": getattr(routine, "healed", 0),
+            "failed": getattr(routine, "failed", 0),
+        }
+
+    @staticmethod
+    def _signal_self(action: str) -> None:
+        """Deliver the service signal to this process AFTER the HTTP
+        response flushes (a small delay thread, like the reference's
+        deferred serviceSignalCh send)."""
+        import os as _os
+        import signal as _signal
+        import sys as _sys
+        import threading as _threading
+        import time as _time
+
+        def fire():
+            _time.sleep(0.5)
+            if action == "stop":
+                _os.kill(_os.getpid(), _signal.SIGTERM)
+            else:  # restart: re-exec the same argv in place
+                try:
+                    _os.execv(_sys.executable, [_sys.executable] + _sys.argv)
+                except OSError:
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+
+        _threading.Thread(target=fire, daemon=True).start()
 
     def _heal_state(self):
         from ..heal.sequence import AllHealState

@@ -219,3 +219,34 @@ def test_admin_healthinfo(server, root_client):
         assert d["state"] == "ok"
         assert d["write_mibps"] > 0 and d["read_mibps"] > 0
         assert d["total"] > 0
+
+
+def test_admin_background_heal_status(server, root_client):
+    r = root_client.request(
+        "GET", f"{ADMIN}/background-heal/status"
+    )
+    assert r.status == 200, r.body
+    node = json.loads(r.body)["nodes"][0]
+    assert node["state"] == "online"
+    assert {"enabled", "queued", "healed", "failed"} <= set(node)
+
+
+def test_admin_service_action_validated(server, root_client, monkeypatch):
+    from minio_tpu.server.admin import AdminAPI
+
+    fired = []
+    monkeypatch.setattr(
+        AdminAPI, "_signal_self",
+        staticmethod(lambda action: fired.append(action)),
+    )
+    r = root_client.request(
+        "POST", f"{ADMIN}/service", query={"action": "bogus"}
+    )
+    assert r.status == 400
+    assert fired == []
+    r = root_client.request(
+        "POST", f"{ADMIN}/service", query={"action": "stop"}
+    )
+    assert r.status == 200, r.body
+    assert fired == ["stop"]
+    assert json.loads(r.body)["action"] == "stop"
