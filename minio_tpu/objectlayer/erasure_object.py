@@ -114,7 +114,15 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     # ------------------------------------------------------------------
 
     def make_bucket(self, bucket: str) -> None:
+        # serialize against concurrent bucket create/delete on this
+        # node: the bucket namespace key is "<bucket>/", disjoint from
+        # every object key (erasure-sets.go:604 MakeBucketLocation
+        # holds the per-bucket lock for the same reason)
         check_bucket_name(bucket)
+        with self.nslock.write(bucket, ""):
+            self._make_bucket(bucket)
+
+    def _make_bucket(self, bucket: str) -> None:
         errs = []
         for d in self._online_disks():
             if d is None:
@@ -159,6 +167,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         return []
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        with self.nslock.write(bucket, ""):
+            self._delete_bucket(bucket, force)
+
+    def _delete_bucket(self, bucket: str, force: bool = False) -> None:
         self.get_bucket_info(bucket)  # existence check
         errs = []
         nonempty = False
@@ -172,7 +184,9 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             except serrors.VolumeNotEmpty as e:
                 nonempty = True
                 errs.append(e)
-            except serrors.VolumeNotFound:
+            except (serrors.VolumeNotFound, FileNotFoundError):
+                # already gone (another node won the delete): a
+                # bucket-level success, never a raw ENOENT in quorum
                 errs.append(None)
             except Exception as e:  # noqa: BLE001
                 errs.append(e)

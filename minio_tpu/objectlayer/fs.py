@@ -105,7 +105,11 @@ class FSObjects(ObjectLayer):
 
     def get_bucket_info(self, bucket: str) -> BucketInfo:
         d = self._require_bucket(bucket)
-        return BucketInfo(bucket, int(os.stat(d).st_ctime_ns))
+        try:
+            return BucketInfo(bucket, int(os.stat(d).st_ctime_ns))
+        except FileNotFoundError:
+            # concurrent delete won between isdir and stat
+            raise BucketNotFound(bucket) from None
 
     def list_buckets(self) -> "list[BucketInfo]":
         out = []

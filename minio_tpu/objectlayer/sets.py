@@ -62,18 +62,24 @@ class ErasureSets(ObjectLayer):
     # -- buckets (fan out to all sets) ------------------------------------
 
     def make_bucket(self, bucket: str) -> None:
-        made = []
-        try:
-            for s in self.sets:
-                s.make_bucket(bucket)
-                made.append(s)
-        except Exception:
-            for s in made:  # undo partial creation (like undoMakeBucket)
-                try:
-                    s.delete_bucket(bucket, force=True)
-                except Exception:  # noqa: BLE001
-                    pass
-            raise
+        # one bucket lock over the whole fan-out so a concurrent
+        # delete can't interleave between sets (erasure-sets.go:604
+        # MakeBucketLocation); the per-set internals are unlocked
+        # because all sets share this nslock and it isn't reentrant
+        api.check_bucket_name(bucket)
+        with self.sets[0].nslock.write(bucket, ""):
+            made = []
+            try:
+                for s in self.sets:
+                    s._make_bucket(bucket)
+                    made.append(s)
+            except Exception:
+                for s in made:  # undo partial creation (undoMakeBucket)
+                    try:
+                        s._delete_bucket(bucket, force=True)
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
 
     def get_bucket_info(self, bucket: str):
         return self.sets[0].get_bucket_info(bucket)
@@ -82,16 +88,17 @@ class ErasureSets(ObjectLayer):
         return self.sets[0].list_buckets()
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
-        # validate emptiness across all sets first when not forcing
-        if not force:
+        with self.sets[0].nslock.write(bucket, ""):
+            # validate emptiness across all sets first when not forcing
+            if not force:
+                for s in self.sets:
+                    if s.list_objects(bucket, max_keys=1).objects:
+                        raise api.BucketNotEmpty(bucket)
             for s in self.sets:
-                if s.list_objects(bucket, max_keys=1).objects:
-                    raise api.BucketNotEmpty(bucket)
-        for s in self.sets:
-            try:
-                s.delete_bucket(bucket, force=True)
-            except api.BucketNotFound:
-                pass
+                try:
+                    s._delete_bucket(bucket, force=True)
+                except api.BucketNotFound:
+                    pass
 
     # -- objects (route by key) -------------------------------------------
 

@@ -32,6 +32,7 @@ class ErasureZones(ObjectLayer):
         if not zones:
             raise ValueError("need at least one zone")
         self.zones = zones
+        self._bucket_ops_lock = threading.Lock()
         self._usage_lock = threading.Lock()
         self._usage_ts = 0.0
         self._usage: "list[tuple[int, int]]" = []  # (free, total) per zone
@@ -136,18 +137,24 @@ class ErasureZones(ObjectLayer):
     # -- buckets ----------------------------------------------------------
 
     def make_bucket(self, bucket: str) -> None:
-        made = []
-        try:
-            for z in self.zones:
-                z.make_bucket(bucket)
-                made.append(z)
-        except Exception:
-            for z in made:
-                try:
-                    z.delete_bucket(bucket, force=True)
-                except Exception:  # noqa: BLE001
-                    pass
-            raise
+        # each zone owns a separate NamespaceLock, so the per-zone
+        # bucket locks don't span the fan-out: a zones-level lock
+        # keeps a concurrent delete from interleaving between zones
+        # (the undoMakeBucket pattern of erasure-zones.go:331 plus
+        # the per-bucket lock of erasure-sets.go:604)
+        with self._bucket_ops_lock:
+            made = []
+            try:
+                for z in self.zones:
+                    z.make_bucket(bucket)
+                    made.append(z)
+            except Exception:
+                for z in made:
+                    try:
+                        z.delete_bucket(bucket, force=True)
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
 
     def get_bucket_info(self, bucket: str):
         return self.zones[0].get_bucket_info(bucket)
@@ -156,15 +163,16 @@ class ErasureZones(ObjectLayer):
         return self.zones[0].list_buckets()
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
-        if not force:
+        with self._bucket_ops_lock:
+            if not force:
+                for z in self.zones:
+                    if z.list_objects(bucket, max_keys=1).objects:
+                        raise api.BucketNotEmpty(bucket)
             for z in self.zones:
-                if z.list_objects(bucket, max_keys=1).objects:
-                    raise api.BucketNotEmpty(bucket)
-        for z in self.zones:
-            try:
-                z.delete_bucket(bucket, force=True)
-            except api.BucketNotFound:
-                pass
+                try:
+                    z.delete_bucket(bucket, force=True)
+                except api.BucketNotFound:
+                    pass
 
     # -- objects ----------------------------------------------------------
 

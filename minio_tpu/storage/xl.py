@@ -15,6 +15,7 @@ crash leaves only garbage in tmp, never a torn object.
 
 from __future__ import annotations
 
+import errno
 import os
 import shutil
 import time
@@ -148,15 +149,40 @@ class XLStorage(StorageAPI):
 
     def stat_vol(self, volume: str) -> VolInfo:
         vp = self._require_vol(volume)
-        return VolInfo(volume, int(os.stat(vp).st_ctime_ns))
+        try:
+            return VolInfo(volume, int(os.stat(vp).st_ctime_ns))
+        except FileNotFoundError:
+            # a concurrent DeleteVol won between the isdir check and
+            # the stat: a bucket-level outcome, never a raw errno
+            raise errors.VolumeNotFound(volume) from None
 
     def delete_vol(self, volume: str, force: bool = False) -> None:
         vp = self._require_vol(volume)
         if force:
-            shutil.rmtree(vp)
+            # rmtree racing a concurrent deleter (root vanishes) or a
+            # concurrent writer (an entry vanishes mid-walk) surfaces
+            # ENOENT; both are linearizable outcomes, not disk faults
+            # (storage-errors.go errno mapping)
+            for _ in range(8):
+                try:
+                    shutil.rmtree(vp)
+                    return
+                except FileNotFoundError:
+                    if not os.path.lexists(vp):
+                        raise errors.VolumeNotFound(volume) from None
+                    continue  # entry vanished mid-walk; retry
+                except OSError as e:
+                    if e.errno in (errno.ENOTEMPTY, errno.EEXIST):
+                        continue  # writer re-filled a dir mid-walk
+                    raise
+            shutil.rmtree(vp, ignore_errors=True)
+            if os.path.lexists(vp):
+                raise errors.VolumeNotEmpty(volume)
             return
         try:
             os.rmdir(vp)
+        except FileNotFoundError:
+            raise errors.VolumeNotFound(volume) from None
         except OSError:
             raise errors.VolumeNotEmpty(volume) from None
 

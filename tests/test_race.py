@@ -254,6 +254,82 @@ def test_concurrent_bucket_create_delete(layer):
         layer.delete_bucket("churn", force=True)
 
 
+def test_bucket_delete_vs_create_interleaving(tmp_path, monkeypatch):
+    """Regression pin for the r4 full-suite failure: a DeleteVol whose
+    directory vanishes underneath it (a racing deleter/creator) must
+    surface a bucket-level outcome (VolumeNotFound -> treated as
+    success by the layer), never a raw ENOENT that quorum accounting
+    counts as a disk fault (WriteQuorumError)."""
+    import shutil as _sh
+
+    from minio_tpu.storage import errors as serrors
+
+    d = XLStorage(str(tmp_path / "one"))
+    d.make_vol("pinned")
+
+    real_rmtree = _sh.rmtree
+
+    def racing_rmtree(path, *a, **kw):
+        # the racing deleter wins between _require_vol and rmtree
+        real_rmtree(path, ignore_errors=True)
+        return real_rmtree(path, *a, **kw)
+
+    monkeypatch.setattr(_sh, "rmtree", racing_rmtree)
+    with pytest.raises(serrors.VolumeNotFound):
+        d.delete_vol("pinned", force=True)
+    monkeypatch.undo()
+
+    # at the erasure layer a disk reporting FileNotFoundError during
+    # DeleteBucket is folded into the bucket-level outcome
+    disks = [XLStorage(str(tmp_path / f"p{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    ol.make_bucket("pinb")
+    orig = disks[0].delete_vol
+
+    def flaky(volume, force=False):
+        orig(volume, force=force)
+        raise FileNotFoundError(2, "No such file or directory")
+
+    disks[0].delete_vol = flaky
+    ol.delete_bucket("pinb", force=True)  # must not raise quorum error
+
+
+def test_bucket_churn_contended(layer):
+    """CPU-contended create/delete churn: the r4 failure appeared only
+    under full-suite load, so burn background CPU while churning."""
+    from minio_tpu.objectlayer.api import BucketExists, BucketNotFound
+
+    stop = threading.Event()
+
+    def burner():
+        while not stop.is_set():
+            hashlib.sha256(b"x" * 8192).digest()
+
+    burners = [
+        threading.Thread(target=burner, daemon=True) for _ in range(4)
+    ]
+    for b in burners:
+        b.start()
+    try:
+
+        def cycler():
+            for _ in range(ROUNDS * 2):
+                try:
+                    layer.make_bucket("churn2")
+                except BucketExists:
+                    pass
+                try:
+                    layer.delete_bucket("churn2", force=True)
+                except BucketNotFound:
+                    pass
+
+        _run_all([cycler for _ in range(6)])
+    finally:
+        stop.set()
+        for b in burners:
+            b.join(timeout=5)
+
+
 def test_concurrent_server_requests(tmp_path):
     """The same invariants through the REAL server: SigV4, routing,
     admission, events all in the hot path."""
