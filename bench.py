@@ -347,9 +347,30 @@ def bench_select_scan() -> dict:
     t0 = time.perf_counter()
     run_select(body, data, lambda _: None)
     dt = time.perf_counter() - t0
+
+    jdata = b"".join(
+        b'{"id": %d, "name": "user%d", "score": %d}\n'
+        % (i, i, i % 100)
+        for i in range(rows)
+    )
+    jbody = (
+        b"<SelectObjectContentRequest>"
+        b"<Expression>SELECT COUNT(*) FROM S3Object WHERE score &gt; 50"
+        b"</Expression><ExpressionType>SQL</ExpressionType>"
+        b"<InputSerialization><JSON><Type>LINES</Type>"
+        b"</JSON></InputSerialization>"
+        b"<OutputSerialization><JSON/></OutputSerialization>"
+        b"</SelectObjectContentRequest>"
+    )
+    run_select(jbody, jdata, lambda _: None)  # warm
+    t0 = time.perf_counter()
+    run_select(jbody, jdata, lambda _: None)
+    jdt = time.perf_counter() - t0
     return {
         "csv_scan_mbps": round(len(data) / dt / 2**20, 1),
         "csv_bytes": len(data),
+        "json_scan_mbps": round(len(jdata) / jdt / 2**20, 1),
+        "json_bytes": len(jdata),
     }
 
 

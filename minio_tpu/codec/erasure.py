@@ -22,6 +22,7 @@ None writer/reader is an offline disk, tolerated down to the quorum.
 from __future__ import annotations
 
 import dataclasses
+import threading
 
 import numpy as np
 
@@ -29,6 +30,25 @@ from . import backend as backend_mod, bitrot, compress
 
 BLOCK_SIZE_V1 = 10 * 1024 * 1024  # reference blockSizeV1
 DEFAULT_BATCH_BLOCKS = 4
+
+
+def _parallel_map(fn, items: list) -> list:
+    """Run fn over items on one thread each (shard-read fan-out); each
+    item is an independent reader so there is no shared state."""
+    results = [None] * len(items)
+
+    def run(idx, it):
+        results[idx] = fn(it)
+
+    threads = [
+        threading.Thread(target=run, args=(idx, it), daemon=True)
+        for idx, it in enumerate(items)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
 
 
 class ErasureError(Exception):
@@ -240,14 +260,25 @@ class Erasure:
     def _decode_blocks(
         self, be, readers, block_indices: list[int], total_length: int
     ) -> tuple[list[bytes], bool]:
-        """Read + verify + reconstruct a batch of blocks -> raw block bytes."""
+        """Read + verify + reconstruct a batch of blocks -> raw block bytes.
+
+        Reads only ``data_blocks`` shards up front (local readers
+        preferred, data shards first among equals) and escalates to
+        parity shards only on read failure or bitrot — a healthy GET
+        never touches parity (erasure-decode.go:63-88 newParallelReader
+        with prefer[], :120-183 Read with missingPartsHeal escalation).
+        """
         k, m = self.data_blocks, self.parity_blocks
         n = k + m
+        while len(readers) < n:
+            readers.append(None)
         sizes = [
             self.shard_size_padded(self._block_len(b, total_length))
             for b in block_indices
         ]
-        heal = False
+        # a reader slot known-dead before we start is a missing shard:
+        # flag heal even though the k-read path may never need it
+        heal = any(readers[s] is None for s in range(n))
         out: list[bytes] = []
         # group contiguous runs with equal shard size into one device pass
         i = 0
@@ -257,35 +288,10 @@ class Erasure:
                 j += 1
             group = block_indices[i:j]
             shard_len = sizes[i]
-            shards = np.zeros((len(group), n, shard_len), dtype=np.uint8)
-            digests = np.zeros((len(group), n, 8), dtype=np.uint32)
-            present = np.zeros((len(group), n), dtype=bool)
-            for gi, b in enumerate(group):
-                off = self.shard_block_offset(b)
-                frame = bitrot.DIGEST_SIZE + shard_len
-                for s in range(n):
-                    r = readers[s] if s < len(readers) else None
-                    if r is None:
-                        continue
-                    try:
-                        buf = r.read_at(off, frame)
-                    except OSError:
-                        readers[s] = None
-                        continue
-                    if len(buf) != frame:
-                        continue
-                    digests[gi, s] = bitrot.digest_from_bytes(
-                        buf[: bitrot.DIGEST_SIZE]
-                    )
-                    shards[gi, s] = np.frombuffer(
-                        buf[bitrot.DIGEST_SIZE :], dtype=np.uint8
-                    )
-                    present[gi, s] = True
-            ok = be.verify(shards, digests) & present
-            if (ok != present).any():
-                heal = True  # bitrot detected somewhere
-            if (~present).any():
-                heal = heal or bool((~present).any(axis=1).any())
+            shards, ok, g_heal = self._read_group_quorum(
+                be, readers, group, shard_len
+            )
+            heal = heal or g_heal
             # reconstruct per distinct pattern (usually one)
             datas = np.zeros((len(group), k, shard_len), dtype=np.uint8)
             patterns: dict[tuple, list[int]] = {}
@@ -293,11 +299,6 @@ class Erasure:
                 pat = tuple(bool(x) for x in ok[gi])
                 patterns.setdefault(pat, []).append(gi)
             for pat, gis in patterns.items():
-                if sum(pat) < k:
-                    raise QuorumError(
-                        f"read quorum lost: {sum(pat)}/{n} shards intact,"
-                        f" need {k}"
-                    )
                 if all(pat[:k]):
                     datas[gis] = shards[gis][:, :k]
                 else:
@@ -311,6 +312,100 @@ class Erasure:
                 out.append(block.tobytes())
             i = j
         return out, heal
+
+    def _read_group_quorum(
+        self, be, readers, group: list[int], shard_len: int
+    ):
+        """Read shard frames for one equal-size block group until every
+        block has >= k intact shards, escalating through the preference
+        order; remote readers are driven concurrently and contiguous
+        frames are fetched in one ranged read per shard (one RTT per
+        shard per batch, the read twin of RemoteShardWriter's pipelined
+        sender threads)."""
+        k, m = self.data_blocks, self.parity_blocks
+        n = k + m
+        g = len(group)
+        frame = bitrot.DIGEST_SIZE + shard_len
+        # full-size blocks sit frame-by-frame in the shard file, so a
+        # whole group is one contiguous byte range; the tail block's
+        # shorter frame is its own group and reads individually
+        contiguous = frame == bitrot.frame_size(self.shard_size())
+        shards = np.zeros((g, n, shard_len), dtype=np.uint8)
+        digests = np.zeros((g, n, 8), dtype=np.uint32)
+        present = np.zeros((g, n), dtype=bool)
+        ok = np.zeros((g, n), dtype=bool)
+        heal = False
+
+        def read_shard(s) -> "list[bytes | None]":
+            r = readers[s]
+            frames: "list[bytes | None]" = [None] * g
+            if r is None:
+                return frames
+            try:
+                if contiguous:
+                    base = self.shard_block_offset(group[0])
+                    buf = r.read_at(base, frame * g)
+                    for gi in range(g):
+                        c = buf[gi * frame : (gi + 1) * frame]
+                        if len(c) == frame:
+                            frames[gi] = c
+                else:
+                    for gi, b in enumerate(group):
+                        c = r.read_at(self.shard_block_offset(b), frame)
+                        if len(c) == frame:
+                            frames[gi] = c
+            except Exception:  # noqa: BLE001 - any failure = dead shard
+                readers[s] = None
+                return [None] * g
+            return frames
+
+        # preference: live readers, local before remote, then natural
+        # order (data shards 0..k-1 first among equals)
+        remaining = sorted(
+            (s for s in range(n) if readers[s] is not None),
+            key=lambda s: (not getattr(readers[s], "is_local", True), s),
+        )
+        while True:
+            deficit = int(k - ok.sum(axis=1).min()) if g else 0
+            if deficit <= 0:
+                break
+            batch, remaining = remaining[:deficit], remaining[deficit:]
+            if not batch:
+                intact = int(ok.sum(axis=1).min())
+                raise QuorumError(
+                    f"read quorum lost: {intact}/{n} shards intact,"
+                    f" need {k}"
+                )
+            if len(batch) > 1 and any(
+                not getattr(readers[s], "is_local", True) for s in batch
+            ):
+                results = _parallel_map(read_shard, batch)
+            else:
+                results = [read_shard(s) for s in batch]
+            for s, frames in zip(batch, results):
+                for gi, c in enumerate(frames):
+                    if c is None:
+                        heal = True  # chosen shard missing/short
+                        continue
+                    digests[gi, s] = bitrot.digest_from_bytes(
+                        c[: bitrot.DIGEST_SIZE]
+                    )
+                    shards[gi, s] = np.frombuffer(
+                        c[bitrot.DIGEST_SIZE :], dtype=np.uint8
+                    )
+                    present[gi, s] = True
+            # verify only the shards just read: a healthy GET hashes
+            # exactly k columns, and escalation rounds never re-hash
+            # already-verified shards
+            bcols = np.asarray(batch)
+            okb = (
+                be.verify(shards[:, bcols], digests[:, bcols])
+                & present[:, bcols]
+            )
+            if (okb != present[:, bcols]).any():
+                heal = True  # bitrot detected somewhere
+            ok[:, bcols] = okb
+        return shards, ok, heal
 
     # ---- heal (cmd/erasure-lowlevel-heal.go:28-48) ----------------------
 

@@ -13,7 +13,7 @@ import io
 import xml.etree.ElementTree as ET
 
 from ..utils.xmlutil import child, child_text, strip_ns
-from . import csvio, jsonio, message, sql
+from . import csvio, jsonio, message, sql, vector
 
 # Records payloads batch up to this size before a frame is flushed
 # (maxRecordSize/bufioWriterSize in the reference's message writer)
@@ -200,35 +200,54 @@ class S3Select:
 
         def flush():
             nonlocal returned
-            if batch:
-                emit(message.records_message(bytes(batch)))
-                returned += len(batch)
-                batch.clear()
+            while batch:
+                part = bytes(batch[:BATCH_BYTES])
+                del batch[:BATCH_BYTES]
+                emit(message.records_message(part))
+                returned += len(part)
+
+        def sink(payload: bytes):
+            batch.extend(payload)
+            if len(batch) >= BATCH_BYTES:
+                flush()
 
         try:
-            records = self._records(self._decompress(stream))
-            matched = 0
-            for row in records:
-                if (
-                    stmt.limit is not None
-                    and not stmt.is_aggregate
-                    and matched >= stmt.limit
-                ):
-                    break
-                if not stmt.matches(row):
-                    continue
-                if stmt.is_aggregate:
-                    stmt.accumulate(row)
-                    continue
-                out = stmt.project(row)
-                if stmt.projections is None:
-                    out = clean(out)
-                batch.extend(writer.serialize(out))
-                if len(batch) >= BATCH_BYTES:
-                    flush()
-                matched += 1
-                if stmt.limit is not None and matched >= stmt.limit:
-                    break
+            if vector.json_eligible(stmt, self.req):
+                # flat JSON-lines aggregates: regex column extraction
+                # + the same mask algebra as the CSV columnar scan
+                vector.FastJSONScan(stmt, self.req).run(
+                    self._decompress(stream)
+                )
+            elif vector.eligible(stmt, self.req):
+                # columnar scan: numpy masks instead of per-row eval,
+                # with exact row-engine fallback per chunk
+                vector.FastScan(
+                    stmt, self.req, writer, clean, sink
+                ).run(self._decompress(stream))
+            else:
+                records = self._records(self._decompress(stream))
+                matched = 0
+                for row in records:
+                    if (
+                        stmt.limit is not None
+                        and not stmt.is_aggregate
+                        and matched >= stmt.limit
+                    ):
+                        break
+                    if not stmt.matches(row):
+                        continue
+                    if stmt.is_aggregate:
+                        stmt.accumulate(row)
+                        continue
+                    out = stmt.project(row)
+                    if stmt.projections is None:
+                        out = clean(out)
+                    batch.extend(writer.serialize(out))
+                    if len(batch) >= BATCH_BYTES:
+                        flush()
+                    matched += 1
+                    if stmt.limit is not None and matched >= stmt.limit:
+                        break
             if stmt.is_aggregate:
                 batch.extend(writer.serialize(stmt.aggregate_result()))
             flush()

@@ -52,6 +52,10 @@ class ShardWriter:
 class ShardReader:
     """Random-access shard-file reader handle (ReadFileStream)."""
 
+    # local readers are preferred by the decode path so healthy GETs
+    # avoid network RTTs (erasure-decode.go prefer[] semantics)
+    is_local = True
+
     def read_at(self, offset: int, length: int) -> bytes:
         raise NotImplementedError
 

@@ -139,6 +139,8 @@ class RemoteShardWriter(ShardWriter):
 
 
 class RemoteShardReader(ShardReader):
+    is_local = False
+
     def __init__(self, client: "StorageRESTClient", volume: str, path: str):
         self._c = client
         self._vol = volume

@@ -201,10 +201,16 @@ def test_bitrot_read_queues_deep_heal(tmp_path):
     data = _pay(2 * BLOCK, seed=9)
     layer.put_object("rot", "obj", io.BytesIO(data), len(data))
 
-    # corrupt one shard's bytes on disk
+    # corrupt a DATA shard's bytes on disk: the k-read GET never
+    # touches parity shards (erasure-decode.go:63-88), so parity
+    # bitrot is the crawler's job, not the read path's; the object's
+    # rotation decides which disk holds data shard 0
+    from minio_tpu.objectlayer.metadata import hash_order
+
+    data_disk = disks[hash_order("rot/obj", 4)[0] - 1]
     part = next(
         os.path.join(dp, f)
-        for dp, _, fs in os.walk(os.path.join(disks[0].root, "rot"))
+        for dp, _, fs in os.walk(os.path.join(data_disk.root, "rot"))
         for f in fs
         if f.startswith("part.")
     )
