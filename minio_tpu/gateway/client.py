@@ -148,9 +148,10 @@ class S3UpstreamClient:
             f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
             f"SignedHeaders={';'.join(signed)}, Signature={sig}"
         )
-        url = enc_path + (
-            f"?{urllib.parse.urlencode(query)}" if query else ""
-        )
+        # wire query must be byte-identical to canonical_q: urlencode's
+        # quote_plus ('+' for space) would break verifiers that
+        # canonicalize from the raw query string (ADVICE r4)
+        url = enc_path + (f"?{canonical_q}" if query else "")
         for attempt in (0, 1):
             conn = self._conn()
             try:

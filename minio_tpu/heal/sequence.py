@@ -192,8 +192,13 @@ class AllHealState:
 
     def launch(self, seq: HealSequence,
                force_start: bool = False) -> dict:
+        # force-start first drains the old walker OUTSIDE the registry
+        # lock (a join under _mu would stall every status poll for up
+        # to the join timeout), then registers the replacement; if the
+        # old walker is wedged past the timeout, proceed anyway - it
+        # has been stopped and exits at its next object boundary
+        old = None
         with self._mu:
-            self._gc_locked()
             existing = self._seqs.get(seq.path)
             if existing is not None and not existing.has_ended():
                 if not force_start:
@@ -204,6 +209,23 @@ class AllHealState:
                         f"token is {existing.client_token}",
                     )
                 existing.stop()
+                old = existing
+        if old is not None:
+            old._thread.join(timeout=30)
+        with self._mu:
+            self._gc_locked()
+            current = self._seqs.get(seq.path)
+            if (
+                current is not None
+                and current is not old
+                and not current.has_ended()
+            ):
+                # a concurrent launch won the race while we drained
+                raise HealSequenceError(
+                    "HealAlreadyRunning",
+                    "Heal is already running on the given path; "
+                    f"token is {current.client_token}",
+                )
             # overlap guard: a parent and child path healing
             # concurrently would double-heal and race renames
             for p, s in self._seqs.items():

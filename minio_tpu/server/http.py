@@ -2065,31 +2065,15 @@ class _Handler(BaseHTTPRequestHandler):
         for k, v in form.items():
             if k.startswith("x-amz-meta-"):
                 meta[k] = v
-        meta.update(self._put_lock_and_tag_meta(bucket, key))
-        from ..objectlayer import quota as quotamod
-
-        quotamod.enforce_put(self.s3, bucket, len(file_data))
-        replicate = self.s3.replication.should_replicate(bucket, key)
-        if replicate:
-            from ..replication.replicate import META_REPLICATION_STATUS
-
-            meta[META_REPLICATION_STATUS] = "PENDING"
         hreader = HashReader(io.BytesIO(file_data), len(file_data))
-        # bucket-default encryption applies to POST uploads too (the
-        # form carries no SSE headers, so only the default can fire)
-        info = self.s3.object_layer.put_object(
-            bucket, key, hreader, len(file_data), meta,
-            sse=self._request_sse(bucket),
-        )
-        if replicate:
-            self.s3.replication.queue(bucket, key, info.version_id)
-        status = form.get("success_action_status", "204")
         from ..event.event import EventName
 
-        self._notify(
-            EventName.OBJECT_CREATED_POST, bucket, key,
-            info.etag, info.size, info.version_id,
+        info = self._checked_put(
+            bucket, key, hreader, len(file_data), meta,
+            versioned=self._versioning(bucket)[0],
+            event_name=EventName.OBJECT_CREATED_POST,
         )
+        status = form.get("success_action_status", "204")
         etag_hdr = {"ETag": f'"{info.etag}"'}
         if status == "201":
             location = f"{self.s3.endpoint}/{bucket}/{key}"
@@ -2307,19 +2291,21 @@ class _Handler(BaseHTTPRequestHandler):
                 meta[lk] = v
         return meta
 
-    def _put_object(self, bucket, key):
-        """Stream the body straight into the erasure encoder in
-        block_size chunks (cmd/erasure-encode.go:73-109) - bounded memory
-        regardless of object size."""
-        reader, size = self._open_body()
+    def _checked_put(
+        self, bucket, key, hreader, size, meta,
+        versioned=False, event_name=None,
+    ):
+        """The full PUT invariant chain - size cap, quota,
+        lock/tagging defaults, replication stamp + queue,
+        bucket-default/requested SSE, event - shared by the S3 PUT,
+        POST-policy, and web-upload paths so the invariants cannot
+        drift between them (objectPutValidate* in the reference's
+        object-handlers.go / web-handlers.go)."""
         if size > MAX_OBJECT_SIZE:
             raise S3Error("EntityTooLarge")
         from ..objectlayer import quota as quotamod
 
         quotamod.enforce_put(self.s3, bucket, size)
-        hreader = self._hash_reader(reader, size)
-        versioned, _ = self._versioning(bucket)
-        meta = self._collect_user_metadata()
         meta.update(self._put_lock_and_tag_meta(bucket, key))
         replicate = self.s3.replication.should_replicate(bucket, key)
         if replicate:
@@ -2335,16 +2321,31 @@ class _Handler(BaseHTTPRequestHandler):
         )
         if replicate:
             self.s3.replication.queue(bucket, key, info.version_id)
+        from ..event.event import EventName
+
+        self._notify(
+            event_name or EventName.OBJECT_CREATED_PUT, bucket, key,
+            info.etag, info.size, info.version_id,
+        )
+        return info
+
+    def _put_object(self, bucket, key):
+        """Stream the body straight into the erasure encoder in
+        block_size chunks (cmd/erasure-encode.go:73-109) - bounded memory
+        regardless of object size."""
+        reader, size = self._open_body()
+        if size > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        hreader = self._hash_reader(reader, size)
+        versioned, _ = self._versioning(bucket)
+        meta = self._collect_user_metadata()
+        info = self._checked_put(
+            bucket, key, hreader, size, meta, versioned=versioned
+        )
         hdrs = {"ETag": f'"{info.etag}"'}
         hdrs.update(self._sse_response_headers(info.user_defined))
         if info.version_id:
             hdrs["x-amz-version-id"] = info.version_id
-        from ..event.event import EventName
-
-        self._notify(
-            EventName.OBJECT_CREATED_PUT, bucket, key,
-            info.etag, info.size, info.version_id,
-        )
         self._respond(200, b"", hdrs)
 
     # -- server-side encryption plumbing (cmd/crypto/header.go,

@@ -169,14 +169,25 @@ def _remove_objects(h, params, access_key) -> dict:
     bucket = params.get("bucketName", "")
     removed, errors = [], []
     versioned, suspended = h._versioning(bucket)
+    from ..event.event import EventName
+
+    _set_event_principal(h, access_key)
     for name in params.get("objects", []):
         try:
             _allow(h, access_key, "s3:DeleteObject", bucket, name)
-            h.s3.object_layer.delete_object(
+            dinfo = h.s3.object_layer.delete_object(
                 bucket, name,
                 versioned=versioned, version_suspended=suspended,
             )
             removed.append(name)
+            # versioned buckets write a delete marker, a distinct
+            # event with the marker's version id (http _delete_object)
+            h._notify(
+                EventName.OBJECT_REMOVED_DELETE_MARKER
+                if dinfo.delete_marker
+                else EventName.OBJECT_REMOVED_DELETE,
+                bucket, name, version_id=dinfo.version_id,
+            )
         except Exception as e:  # noqa: BLE001
             errors.append({"object": name, "error": str(e)})
     return {"removed": removed, "errors": errors}
@@ -288,6 +299,15 @@ def _rpc_result(h, rid, result) -> None:
     )
 
 
+def _set_event_principal(h, access_key: str) -> None:
+    """Bearer-token web requests never run sigv4 verification, so
+    h._auth stays None and events would carry an empty principal;
+    stamp the authenticated web identity before notifying."""
+    from .auth import AuthContext
+
+    h._auth = AuthContext(access_key=access_key, kind="web-jwt")
+
+
 def _rpc_error(h, rid, message: str) -> None:
     h._respond(
         200,  # jsonrpc transports errors in-band
@@ -316,8 +336,12 @@ def _upload(h, bucket: str, obj: str) -> None:
         raise S3Error("MissingContentLength")
     from ..utils.hashreader import HashReader
 
+    # the S3 PUT invariant chain (size cap, quota, lock defaults,
+    # bucket-default SSE, replication, event) rides the shared
+    # helper so web uploads can never drift from it (ADVICE r4)
+    _set_event_principal(h, access_key)
     versioned, _ = h._versioning(bucket)
-    info = h.s3.object_layer.put_object(
+    info = h._checked_put(
         bucket,
         obj,
         HashReader(reader, size),
@@ -344,6 +368,17 @@ def _download(h, bucket: str, obj: str, query) -> None:
     except WebError:
         raise S3Error("AccessDenied") from None
     info = h.s3.object_layer.get_object_info(bucket, obj)
+    from ..codec import sse as ssemod
+
+    if (info.user_defined or {}).get(ssemod.META_SSE) == "C":
+        # a web download cannot supply the customer key; failing
+        # before end_headers() beats a truncated 200 (ADVICE r4)
+        raise S3Error(
+            "InvalidRequest",
+            "The object was stored using a form of Server Side "
+            "Encryption. The correct parameters must be provided "
+            "to retrieve the object.",
+        )
     h.send_response(200)
     h.send_header("Server", "MinIO-TPU")
     h.send_header("Content-Type", "application/octet-stream")

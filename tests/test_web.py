@@ -283,3 +283,148 @@ def test_console_page_served(server):
     # anonymous: the page itself carries no data and POST is refused
     st, _h, _b = _raw(server, "POST", "/minio-tpu/console")
     assert st == 405
+
+
+def test_web_upload_honors_bucket_sse_and_emits_event(
+    server, monkeypatch
+):
+    """ADVICE r4: the web upload plane must apply bucket-default SSE
+    and fire s3:ObjectCreated:Put like the S3 PUT path."""
+    import os
+
+    from minio_tpu.codec import kms, sse as ssemod
+
+    monkeypatch.setenv(
+        "MINIO_TPU_KMS_MASTER_KEY", "webkey:" + "ab" * 32
+    )
+    kms.reset_kms_cache()
+    try:
+        token = _login(server)
+        _rpc(server, "web.MakeBucket", {"bucketName": "webenc"}, token)
+        # bucket default encryption: SSE-S3
+        c = S3Client(server.endpoint)
+        enc = (
+            b"<ServerSideEncryptionConfiguration><Rule>"
+            b"<ApplyServerSideEncryptionByDefault>"
+            b"<SSEAlgorithm>AES256</SSEAlgorithm>"
+            b"</ApplyServerSideEncryptionByDefault>"
+            b"</Rule></ServerSideEncryptionConfiguration>"
+        )
+        assert c.request(
+            "PUT", "/webenc", query={"encryption": ""}, body=enc
+        ).status == 200
+        events = []
+        server.events.send, orig = (
+            lambda ev: events.append(ev), server.events.send,
+        )
+        # the no-rules/no-listeners O(1) short-circuit would skip
+        # send entirely; pretend someone is watching
+        server.events.has_listeners, orig_hl = (
+            lambda bucket: True, server.events.has_listeners,
+        )
+        try:
+            st, _h, _b = _raw(
+                server, "PUT", "/minio-tpu/web/upload/webenc/secret",
+                b"payload-bytes",
+                {
+                    "Authorization": f"Bearer {token}",
+                    "Content-Length": "13",
+                },
+            )
+            assert st == 200
+        finally:
+            server.events.send = orig
+            server.events.has_listeners = orig_hl
+        info = server.object_layer.get_object_info("webenc", "secret")
+        assert info.user_defined.get(ssemod.META_SSE) == "S3"
+        assert [str(getattr(e.name, "value", e.name)) for e in events] == [
+            "s3:ObjectCreated:Put"
+        ]
+        # the S3 GET path transparently decrypts
+        r = c.get_object("webenc", "secret")
+        assert r.status == 200 and r.body == b"payload-bytes"
+    finally:
+        kms.reset_kms_cache()
+
+
+def test_web_download_ssec_clean_error(server):
+    """ADVICE r4: downloading an SSE-C object via the web plane must
+    fail before headers, not truncate mid-stream."""
+    import io as iomod
+
+    from minio_tpu.codec import sse as ssemod
+
+    token = _login(server)
+    _rpc(server, "web.MakeBucket", {"bucketName": "webssec"}, token)
+    server.object_layer.put_object(
+        "webssec", "locked", iomod.BytesIO(b"secret-data"), 11,
+        sse=ssemod.SSESpec("C", b"C" * 32),
+    )
+    url_token = _rpc(server, "web.CreateURLToken", {}, token)[
+        "result"
+    ]["token"]
+    st, _h, body = _raw(
+        server, "GET",
+        "/minio-tpu/web/download/webssec/locked?"
+        + urllib.parse.urlencode({"token": url_token}),
+    )
+    assert st == 400
+    assert b"Server Side Encryption" in body
+
+
+def test_web_upload_enforces_quota(server):
+    token = _login(server)
+    _rpc(server, "web.MakeBucket", {"bucketName": "webq"}, token)
+    c = S3Client(server.endpoint)
+    r = c.request(
+        "PUT", "/minio-tpu/admin/v1/set-bucket-quota",
+        query={"bucket": "webq"},
+        body=json.dumps({"quota": 10, "quotatype": "hard"}).encode(),
+    )
+    assert r.status == 200, r.body
+    st, _h, body = _raw(
+        server, "PUT", "/minio-tpu/web/upload/webq/big",
+        b"x" * 100,
+        {"Authorization": f"Bearer {token}", "Content-Length": "100"},
+    )
+    assert st == 400 and b"QuotaExceeded" in body, (st, body)
+
+
+def test_web_upload_applies_default_retention(server):
+    """r5 review: bucket-default object-lock retention must stamp web
+    uploads too, else the web plane is a WORM bypass."""
+    token = _login(server)
+    c = S3Client(server.endpoint)
+    assert c.request(
+        "PUT", "/webworm",
+        headers={"x-amz-bucket-object-lock-enabled": "true"},
+    ).status == 200
+    cfg = (
+        b"<ObjectLockConfiguration>"
+        b"<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+        b"<Rule><DefaultRetention><Mode>COMPLIANCE</Mode>"
+        b"<Days>1</Days></DefaultRetention></Rule>"
+        b"</ObjectLockConfiguration>"
+    )
+    assert c.request(
+        "PUT", "/webworm", query={"object-lock": ""}, body=cfg
+    ).status == 200
+    st, _h, _b = _raw(
+        server, "PUT", "/minio-tpu/web/upload/webworm/precious",
+        b"keep-me",
+        {"Authorization": f"Bearer {token}", "Content-Length": "7"},
+    )
+    assert st == 200
+    from minio_tpu.objectlayer import objectlock as olock
+
+    info = server.object_layer.get_object_info("webworm", "precious")
+    assert info.user_defined.get(olock.META_MODE) == "COMPLIANCE"
+    # and the WORM guard blocks deleting the locked VERSION (an
+    # unqualified DELETE only writes a marker, which S3 allows)
+    r = c.request(
+        "DELETE", "/webworm/precious",
+        query={"versionId": info.version_id},
+    )
+    assert r.status in (400, 403) and b"ObjectLocked" in r.body, (
+        r.status, r.body,
+    )
