@@ -98,6 +98,10 @@ class PeerRESTServer:
         self.fingerprint = fingerprint or {}
         self.local_locker = local_locker
         self.started = time.time()
+        # remote ListenBucketNotification subscriptions (listenon/
+        # listenbuf/listenoff); GC'd when a watcher stops polling
+        self._listeners: "dict[str, dict]" = {}
+        self._listen_mu = threading.Lock()
 
     # -- RPC implementations ---------------------------------------------
 
@@ -238,6 +242,258 @@ class PeerRESTServer:
             return {"ok": False, "mismatch": mism}
         return {"ok": True}
 
+    # -- granular IAM invalidation (LoadUser/LoadPolicy/... peer RPCs,
+    #    peer-rest-server.go LoadUserHandler etc.) -------------------------
+
+    def _iam(self):
+        return getattr(self.s3, "iam", None)
+
+    def _load_user(self, q, body) -> dict:
+        iam = self._iam()
+        if iam is not None:
+            iam.load_user(_q1(q, "name"))
+        return {"ok": True}
+
+    def _delete_user(self, q, body) -> dict:
+        iam = self._iam()
+        if iam is not None:
+            iam.drop_user(_q1(q, "name"))
+        return {"ok": True}
+
+    def _load_policy(self, q, body) -> dict:
+        iam = self._iam()
+        if iam is not None:
+            iam.load_policy(_q1(q, "name"))
+        return {"ok": True}
+
+    def _delete_policy(self, q, body) -> dict:
+        iam = self._iam()
+        if iam is not None:
+            iam.drop_policy(_q1(q, "name"))
+        return {"ok": True}
+
+    def _load_group(self, q, body) -> dict:
+        iam = self._iam()
+        if iam is not None:
+            iam.load_group(_q1(q, "name"))
+        return {"ok": True}
+
+    def _load_policy_mapping(self, q, body) -> dict:
+        """The user/group -> policy mapping rides the entity doc in
+        this design, so reloading the entity reloads the mapping."""
+        iam = self._iam()
+        if iam is not None:
+            if _q1(q, "isGroup") in ("1", "true"):
+                iam.load_group(_q1(q, "name"))
+            else:
+                iam.load_user(_q1(q, "name"))
+        return {"ok": True}
+
+    # -- misc parity RPCs --------------------------------------------------
+
+    def _get_local_disk_ids(self, q, body) -> dict:
+        """IDs of this node's LOCAL drives (GetLocalDiskIDs)."""
+        from ..server.metrics import _iter_disks
+        from ..storage.rest_client import StorageRESTClient
+
+        ids = []
+        ol = self.s3.object_layer
+        if ol is not None:
+            for d in _iter_disks(ol):
+                if d is None:
+                    continue
+                inner = getattr(d, "disk", d)
+                if isinstance(inner, StorageRESTClient):
+                    continue
+                try:
+                    ids.append(d.get_disk_id())
+                except Exception:  # noqa: BLE001
+                    continue
+        return {"ids": ids}
+
+    def _reload_format(self, q, body) -> dict:
+        """Re-probe local drives against the reference format and
+        re-admit healed/replaced ones (ReloadFormat after heal)."""
+        monitor = getattr(self.s3, "disk_monitor", None)
+        if monitor is None:
+            return {"ok": False, "error": "no disk monitor"}
+        return {"ok": True, "stamped": monitor.scan_once()}
+
+    def _server_update(self, q, body) -> dict:
+        """ServerUpdate parity endpoint: in-place binary updates are
+        not a thing in this build (deploys replace the image), so the
+        RPC answers like mc admin update against a source build."""
+        return {
+            "ok": False,
+            "error": "server updates are disabled in this build",
+        }
+
+    def _log(self, q, body) -> dict:
+        """Append a remote node's console line into this node's ring
+        (the console-target fan-in the reference's /log carries)."""
+        entry = _unpack(body) or {}
+        self.s3.console.ring.append(dict(entry))
+        return {"ok": True}
+
+    # -- granular OBD slices (the reference's per-subsystem OBD RPCs;
+    #    one local doc, sliced per method) ---------------------------------
+
+    _OBD_CACHE_S = 5.0
+
+    def _obd_slice(self, keys) -> dict:
+        ol = self.s3.object_layer
+        if ol is None:
+            return {"state": "initializing"}
+        # one OBD collection fans out to every per-subsystem RPC; a
+        # short-lived cache keeps that from re-running the full drive
+        # probe six times per burst
+        cached = getattr(self, "_obd_doc", None)
+        if cached is None or time.monotonic() - cached[0] > (
+            self._OBD_CACHE_S
+        ):
+            from ..server.admin import AdminAPI
+
+            cached = (
+                time.monotonic(),
+                AdminAPI(self.s3)._health_info_local(ol),
+            )
+            self._obd_doc = cached
+        doc = cached[1]
+        return {k: doc.get(k) for k in ("endpoint", *keys)}
+
+    def _drive_obd(self, q, body) -> dict:
+        return self._obd_slice(("drives",))
+
+    def _mem_obd(self, q, body) -> dict:
+        return self._obd_slice(
+            ("mem_total_bytes", "mem_available_bytes")
+        )
+
+    def _cpu_obd(self, q, body) -> dict:
+        return self._obd_slice(("cpus", "platform"))
+
+    def _os_obd(self, q, body) -> dict:
+        return self._obd_slice(("platform", "python", "version"))
+
+    def _proc_obd(self, q, body) -> dict:
+        return self._obd_slice(("uptime_seconds", "state"))
+
+    def _diskhw_obd(self, q, body) -> dict:
+        return self._obd_slice(("drives",))
+
+    def _net_obd(self, q, body) -> dict:
+        """This node's view of the internode network: health RTT to
+        every peer (NetOBDInfo's latency matrix, one row)."""
+        peers = getattr(self.s3, "peer_notifier", None)
+        out = []
+        for c in getattr(peers, "clients", []):
+            t0 = time.monotonic()
+            try:
+                ok = bool(c.health().get("ok"))
+            except Exception:  # noqa: BLE001
+                ok = False
+            out.append(
+                {
+                    "peer": f"{c.host}:{c.port}",
+                    "ok": ok,
+                    "rtt_ms": round(
+                        (time.monotonic() - t0) * 1e3, 2
+                    ),
+                }
+            )
+        return {"endpoint": self.s3.endpoint, "net": out}
+
+    def _dispatch_net_obd(self, q, body) -> dict:
+        """Ask every peer for ITS net row (DispatchNetOBDInfo)."""
+        peers = getattr(self.s3, "peer_notifier", None)
+        rows = [self._net_obd(q, body)]
+        if peers is not None:
+            rows.extend(
+                peers._gather(
+                    lambda c: c.call("netobdinfo", retry=False),
+                    lambda c: {
+                        "endpoint": f"{c.host}:{c.port}",
+                        "net": [],
+                    },
+                )
+            )
+        return {"rows": rows}
+
+    # -- cluster-wide event listen (the Listen peer RPC,
+    #    cmd/notification.go:440 remote listen targets; poll-delivered
+    #    like tracebuf, matching this design's internode idiom) -----------
+
+    _LISTEN_TTL_S = 60.0
+
+    def _listen_gc_locked(self) -> None:
+        now = time.monotonic()
+        for lid in [
+            lid
+            for lid, rec in self._listeners.items()
+            if now - rec["polled"] > self._LISTEN_TTL_S
+        ]:
+            rec = self._listeners.pop(lid)
+            self.s3.events.unsubscribe_listener(
+                rec["bucket"], rec["sub"]
+            )
+
+    def _listen_on(self, q, body) -> dict:
+        """Register a remote listener: events this node generates for
+        the bucket start flowing into a pollable queue."""
+        doc = _unpack(body) or {}
+        bucket = doc.get("bucket", "")
+        lid = doc.get("id", "")
+        if not bucket or not lid:
+            return {"ok": False, "error": "bucket and id required"}
+        with self._listen_mu:
+            self._listen_gc_locked()
+            if lid in self._listeners:
+                return {"ok": True}
+            sub = self.s3.events.subscribe_listener(bucket)
+            self._listeners[lid] = {
+                "bucket": bucket,
+                "sub": sub,
+                "prefix": doc.get("prefix", ""),
+                "suffix": doc.get("suffix", ""),
+                "names": set(doc.get("names") or []),
+                "polled": time.monotonic(),
+            }
+        return {"ok": True}
+
+    def _listen_buf(self, q, body) -> dict:
+        """Drain a remote listener's queue: wire-ready notification
+        records, filtered server-side like the local stream."""
+        from ..event.event import matches_filter, to_listen_record
+
+        lid = _q1(q, "id")
+        with self._listen_mu:
+            # GC here too: a watcher that died without listenoff must
+            # not leak its subscription until the next listenon
+            self._listen_gc_locked()
+            rec = self._listeners.get(lid)
+            if rec is None:
+                return {"ok": False, "records": []}
+            rec["polled"] = time.monotonic()
+        out = [
+            to_listen_record(ev)
+            for ev in rec["sub"].drain()
+            if matches_filter(
+                ev, rec["bucket"], rec["names"],
+                rec["prefix"], rec["suffix"],
+            )
+        ]
+        return {"ok": True, "records": out}
+
+    def _listen_off(self, q, body) -> dict:
+        lid = _q1(q, "id")
+        with self._listen_mu:
+            rec = self._listeners.pop(lid, None)
+        if rec is not None:
+            self.s3.events.unsubscribe_listener(
+                rec["bucket"], rec["sub"]
+            )
+        return {"ok": True}
+
     _METHODS = {
         "health": _health,
         "serverinfo": _server_info,
@@ -247,14 +503,44 @@ class PeerRESTServer:
         "loadconfig": _load_config,
         "getlocks": _get_locks,
         "tracebuf": _trace_buf,
+        "trace": _trace_buf,  # reference-parity alias
         "consolebuf": _console_buf,
         "startprofiling": _start_profiling,
         "downloadprofiling": _download_profiling,
+        "downloadprofilingdata": _download_profiling,  # parity alias
         "healthinfo": _health_info,
         "bghealstatus": _bg_heal_status,
+        "backgroundhealstatus": _bg_heal_status,  # parity alias
         "signalservice": _signal_service,
         "cyclebloom": _cycle_bloom,
         "verifyconfig": _verify_config,
+        # granular IAM
+        "loaduser": _load_user,
+        "loadserviceaccount": _load_user,  # same store kind
+        "deleteuser": _delete_user,
+        "deleteserviceaccount": _delete_user,
+        "loadpolicy": _load_policy,
+        "deletepolicy": _delete_policy,
+        "loadgroup": _load_group,
+        "loadpolicymapping": _load_policy_mapping,
+        # misc parity
+        "getlocaldiskids": _get_local_disk_ids,
+        "reloadformat": _reload_format,
+        "serverupdate": _server_update,
+        "log": _log,
+        # granular OBD
+        "driveobdinfo": _drive_obd,
+        "memobdinfo": _mem_obd,
+        "cpuobdinfo": _cpu_obd,
+        "osinfoobdinfo": _os_obd,
+        "procobdinfo": _proc_obd,
+        "diskhwobdinfo": _diskhw_obd,
+        "netobdinfo": _net_obd,
+        "dispatchnetobdinfo": _dispatch_net_obd,
+        # cluster-wide event listen
+        "listenon": _listen_on,
+        "listenbuf": _listen_buf,
+        "listenoff": _listen_off,
     }
 
     # -- dispatch (internode-plane calling convention) --------------------
@@ -410,6 +696,36 @@ class PeerRESTClient:
     def verify_config(self, fingerprint: dict) -> dict:
         return self.call("verifyconfig", doc=fingerprint)
 
+    def get_local_disk_ids(self) -> list:
+        return self.call("getlocaldiskids").get("ids", [])
+
+    def reload_format(self) -> dict:
+        return self.call("reloadformat", retry=False)
+
+    def listen_on(
+        self, lid: str, bucket: str,
+        prefix: str = "", suffix: str = "", names=None,
+    ) -> None:
+        self.call(
+            "listenon",
+            doc={
+                "id": lid, "bucket": bucket, "prefix": prefix,
+                "suffix": suffix, "names": sorted(names or []),
+            },
+            retry=False,
+        )
+
+    def listen_buf(self, lid: str) -> "list[dict]":
+        resp = self.call("listenbuf", {"id": lid}, retry=False)
+        if not resp.get("ok"):
+            # the peer GC'd this listener (stalled poller): the caller
+            # must re-register, exactly like after a transport error
+            raise ConnectionError("listener expired on peer")
+        return resp.get("records", [])
+
+    def listen_off(self, lid: str) -> None:
+        self.call("listenoff", {"id": lid}, retry=False)
+
     def is_online(self) -> bool:
         try:
             return bool(self.health().get("ok"))
@@ -455,6 +771,30 @@ class PeerNotifier:
 
     def iam_changed(self) -> None:
         self._fanout(lambda c: c.load_iam())
+
+    # granular IAM invalidation: one entity reload instead of a full
+    # store re-scan on every peer (LoadUser/LoadPolicy/... RPCs)
+    _IAM_METHOD = {
+        ("users", False): "loaduser",
+        ("users", True): "deleteuser",
+        ("sts", False): "loaduser",
+        ("sts", True): "deleteuser",
+        ("policies", False): "loadpolicy",
+        ("policies", True): "deletepolicy",
+        ("groups", False): "loadgroup",
+        ("groups", True): "loadgroup",  # reload observes the delete
+    }
+
+    def iam_entity(
+        self, kind: str, name: str, deleted: bool = False
+    ) -> None:
+        method = self._IAM_METHOD.get((kind, deleted))
+        if method is None:
+            self.iam_changed()
+            return
+        self._fanout(
+            lambda c: c.call(method, {"name": name}, retry=False)
+        )
 
     def config_changed(self) -> None:
         self._fanout(lambda c: c.load_config())

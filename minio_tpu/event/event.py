@@ -115,3 +115,26 @@ class Event:
                 },
             },
         }
+
+
+def matches_filter(
+    ev: "Event", bucket: str, names, prefix: str, suffix: str
+) -> bool:
+    """The ListenBucketNotification match predicate, shared by the
+    local stream loop and the remote listenbuf RPC so local and
+    cluster watchers can never disagree on what matches."""
+    if ev.bucket != bucket:
+        return False
+    if names and ev.name not in names:
+        return False
+    key = ev.object_key
+    return key.startswith(prefix) and key.endswith(suffix)
+
+
+def to_listen_record(ev: "Event") -> dict:
+    """Wire shape of one notification line/record."""
+    return {
+        "EventName": ev.name,
+        "Key": f"{ev.bucket}/{ev.object_key}",
+        "Records": [ev.to_record()],
+    }

@@ -103,8 +103,7 @@ class IAMSys:
             io.BytesIO(raw),
             len(raw),
         )
-        if self.notifier is not None:
-            self.notifier.iam_changed()
+        self._notify_peers(kind, name, deleted=False)
 
     def _delete_doc(self, kind: str, name: str) -> None:
         if self._ol is None:
@@ -115,7 +114,19 @@ class IAMSys:
             )
         except ObjectNotFound:
             pass
-        if self.notifier is not None:
+        self._notify_peers(kind, name, deleted=True)
+
+    def _notify_peers(
+        self, kind: str, name: str, deleted: bool
+    ) -> None:
+        if self.notifier is None:
+            return
+        # granular invalidation when the notifier supports it (one
+        # entity reload on each peer); coarse full-reload otherwise
+        entity = getattr(self.notifier, "iam_entity", None)
+        if entity is not None:
+            entity(kind, name, deleted=deleted)
+        else:
             self.notifier.iam_changed()
 
     def _load_docs(self, kind: str) -> "dict[str, dict]":
@@ -141,6 +152,94 @@ class IAMSys:
             if not res.is_truncated:
                 return out
             marker = res.next_marker
+
+    # sentinel distinguishing "the doc does not exist" (evict the
+    # cached entity) from a transient read failure (KEEP the cached
+    # entity - evicting a valid credential on a quorum blip would
+    # lock a live user out until the periodic refresher runs)
+    _ABSENT = object()
+
+    def _load_one_doc(self, kind: str, name: str):
+        """The doc dict, ``_ABSENT`` when it does not exist (or is
+        corrupt), or None on a transient read failure."""
+        buf = io.BytesIO()
+        try:
+            self._ol.get_object(
+                META_BUCKET, self._store_path(kind, name), buf
+            )
+        except ObjectNotFound:
+            return self._ABSENT
+        except Exception:  # noqa: BLE001 - quorum blip etc.
+            return None
+        try:
+            return json.loads(buf.getvalue())
+        except ValueError:
+            return self._ABSENT
+
+    # -- granular peer invalidation (LoadUser/LoadPolicy/LoadGroup
+    #    peer RPCs reload ONE entity instead of the whole store) ----------
+
+    def load_user(self, access_key: str) -> bool:
+        """Reload one user / service account / STS credential from its
+        persisted doc; drops it only when the doc is truly gone."""
+        if self._ol is None or not access_key:
+            return False
+        doc = self._load_one_doc("users", access_key)
+        if doc is self._ABSENT:
+            sts = self._load_one_doc("sts", access_key)
+            if isinstance(sts, dict) and sts.get(
+                "expiration", 0
+            ) > time.time():
+                doc = sts
+        if doc is None:
+            return False  # transient failure: keep the cache
+        with self._mu:
+            if doc is self._ABSENT:
+                self._users.pop(access_key, None)
+                return False
+            self._users[access_key] = doc
+        return True
+
+    def drop_user(self, access_key: str) -> None:
+        with self._mu:
+            self._users.pop(access_key, None)
+
+    def load_policy(self, name: str) -> bool:
+        if self._ol is None or not name:
+            return False
+        doc = self._load_one_doc("policies", name)
+        if doc is None:
+            return False  # transient failure: keep the cache
+        with self._mu:
+            if doc is self._ABSENT:
+                self._policies.pop(name, None)
+                if name in CANNED_POLICIES:
+                    self._policies[name] = CANNED_POLICIES[name]
+                return False
+            try:
+                self._policies[name] = Policy.from_dict(doc)
+            except PolicyError:
+                return False
+        return True
+
+    def drop_policy(self, name: str) -> None:
+        with self._mu:
+            self._policies.pop(name, None)
+            if name in CANNED_POLICIES:
+                self._policies[name] = CANNED_POLICIES[name]
+
+    def load_group(self, name: str) -> bool:
+        if self._ol is None or not name:
+            return False
+        doc = self._load_one_doc("groups", name)
+        if doc is None:
+            return False  # transient failure: keep the cache
+        with self._mu:
+            if doc is self._ABSENT:
+                self._groups.pop(name, None)
+                return False
+            self._groups[name] = doc
+        return True
 
     def start_refresher(self, interval_s: float = 120.0):
         """Periodic reload fallback (iam.go watch loop): peer

@@ -467,6 +467,7 @@ def main(argv=None) -> int:
         _heal_routine, _disk_monitor = start_background_heal(ol)
         srv.heal_routine = _heal_routine
         srv.heal_queue = _heal_routine.queue
+        srv.disk_monitor = _disk_monitor  # reloadformat peer RPC
     # data-update tracker: object mutations mark a persisted bloom
     # journal the crawler uses to skip clean buckets
     # (data-update-tracker.go:63)

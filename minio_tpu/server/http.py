@@ -1351,14 +1351,18 @@ class _Handler(BaseHTTPRequestHandler):
         stream matching events to the client as JSON lines with
         whitespace keep-alives, until it disconnects.
 
-        Events observed are the ones THIS node generates; in a
-        multi-node deployment a watcher sees its node's writes (the
-        reference fans the subscription out over its peer Listen RPC
-        - a noted gap here, exact on single-node).
+        CLUSTER-WIDE: the subscription fans out over the peer plane
+        (listenon/listenbuf/listenoff RPCs - the Listen peer RPC of
+        cmd/notification.go:440), so a watcher on this node sees
+        events originated on every node; remote records are polled by
+        per-peer threads and merged into the same stream.
         """
         import json as _json
+        import uuid as _uuid
 
         from ..event.event import EventName
+        from ..event.event import matches_filter as ev_matches
+        from ..event.event import to_listen_record
 
         self.s3.object_layer.get_bucket_info(bucket)
         prefix = query.get("prefix", [""])[0]
@@ -1376,6 +1380,48 @@ class _Handler(BaseHTTPRequestHandler):
                 names.update(EventName.expand(part))
         self._finish_body()
         sub = self.s3.events.subscribe_listener(bucket)
+        # remote fan-out: register on every peer, poll each from its
+        # own thread so one slow peer never stalls the stream
+        import collections as _collections
+        import threading as _threading
+
+        remote_lines: "_collections.deque" = _collections.deque(
+            maxlen=10_000
+        )
+        stop_remote = _threading.Event()
+        pollers: "list[_threading.Thread]" = []
+        lid = _uuid.uuid4().hex
+        notifier = getattr(self.s3, "peer_notifier", None)
+
+        def poll_peer(client):
+            registered = False
+            while not stop_remote.is_set():
+                try:
+                    if not registered:
+                        client.listen_on(
+                            lid, bucket, prefix, suffix, names
+                        )
+                        registered = True
+                    for rec in client.listen_buf(lid):
+                        remote_lines.append(
+                            _json.dumps(rec).encode() + b"\n"
+                        )
+                except Exception:  # noqa: BLE001
+                    registered = False  # peer bounced; re-register
+                stop_remote.wait(0.25)
+            if registered:
+                try:
+                    client.listen_off(lid)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        for client in getattr(notifier, "clients", []):
+            t = _threading.Thread(
+                target=poll_peer, args=(client,), daemon=True,
+                name=f"listen-poll-{client.host}:{client.port}",
+            )
+            t.start()
+            pollers.append(t)
         self.send_response(200)
         self.send_header("Server", "MinIO-TPU")
         self.send_header("Content-Type", "application/json")
@@ -1395,21 +1441,18 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(b" ")
                     self.wfile.flush()
                     last_keepalive = now
+                while remote_lines:
+                    line = remote_lines.popleft()
+                    self.wfile.write(line)
+                    self.wfile.flush()
+                    self._resp_bytes += len(line)
+                    last_keepalive = now
                 if ev is None:
                     continue
-                if ev.bucket != bucket:
-                    continue
-                if names and ev.name not in names:
-                    continue
-                key = ev.object_key
-                if not (key.startswith(prefix) and key.endswith(suffix)):
+                if not ev_matches(ev, bucket, names, prefix, suffix):
                     continue
                 line = _json.dumps(
-                    {
-                        "EventName": ev.name,
-                        "Key": f"{ev.bucket}/{key}",
-                        "Records": [ev.to_record()],
-                    }
+                    to_listen_record(ev)
                 ).encode() + b"\n"
                 self.wfile.write(line)
                 self.wfile.flush()
@@ -1418,6 +1461,11 @@ class _Handler(BaseHTTPRequestHandler):
         except OSError:
             pass  # client went away: the normal way this ends
         finally:
+            stop_remote.set()
+            # join so listen_off reliably fires before the handler
+            # returns (each poller wakes within 0.25s)
+            for t in pollers:
+                t.join(timeout=2)
             self.s3.events.unsubscribe_listener(bucket, sub)
 
     def _list_buckets(self):
