@@ -99,9 +99,13 @@ class PeerRESTServer:
         self.local_locker = local_locker
         self.started = time.time()
         # remote ListenBucketNotification subscriptions (listenon/
-        # listenbuf/listenoff); GC'd when a watcher stops polling
+        # listenbuf/listenoff); GC'd when a watcher stops polling -
+        # on every listen RPC and by a background sweeper, so an
+        # orphaned subscription dies even if listen traffic stops
         self._listeners: "dict[str, dict]" = {}
         self._listen_mu = threading.Lock()
+        self._listen_gc_thread: "threading.Thread | None" = None
+        self._obd_mu = threading.Lock()
 
     # -- RPC implementations ---------------------------------------------
 
@@ -347,18 +351,19 @@ class PeerRESTServer:
         # one OBD collection fans out to every per-subsystem RPC; a
         # short-lived cache keeps that from re-running the full drive
         # probe six times per burst
-        cached = getattr(self, "_obd_doc", None)
-        if cached is None or time.monotonic() - cached[0] > (
-            self._OBD_CACHE_S
-        ):
-            from ..server.admin import AdminAPI
+        with self._obd_mu:  # one probe per burst, not one per RPC
+            cached = getattr(self, "_obd_doc", None)
+            if cached is None or time.monotonic() - cached[0] > (
+                self._OBD_CACHE_S
+            ):
+                from ..server.admin import AdminAPI
 
-            cached = (
-                time.monotonic(),
-                AdminAPI(self.s3)._health_info_local(ol),
-            )
-            self._obd_doc = cached
-        doc = cached[1]
+                cached = (
+                    time.monotonic(),
+                    AdminAPI(self.s3)._health_info_local(ol),
+                )
+                self._obd_doc = cached
+            doc = cached[1]
         return {k: doc.get(k) for k in ("endpoint", *keys)}
 
     def _drive_obd(self, q, body) -> dict:
@@ -378,28 +383,34 @@ class PeerRESTServer:
     def _proc_obd(self, q, body) -> dict:
         return self._obd_slice(("uptime_seconds", "state"))
 
-    def _diskhw_obd(self, q, body) -> dict:
-        return self._obd_slice(("drives",))
-
     def _net_obd(self, q, body) -> dict:
         """This node's view of the internode network: health RTT to
-        every peer (NetOBDInfo's latency matrix, one row)."""
+        every peer (NetOBDInfo's latency matrix, one row).  Probes run
+        concurrently with no retry so one blackholed peer costs ONE
+        timeout, not a serial walk past the caller's deadline."""
         peers = getattr(self.s3, "peer_notifier", None)
-        out = []
-        for c in getattr(peers, "clients", []):
+
+        def probe(c) -> dict:
             t0 = time.monotonic()
             try:
-                ok = bool(c.health().get("ok"))
+                ok = bool(c.call("health", retry=False).get("ok"))
             except Exception:  # noqa: BLE001
                 ok = False
-            out.append(
-                {
+            return {
+                "peer": f"{c.host}:{c.port}",
+                "ok": ok,
+                "rtt_ms": round((time.monotonic() - t0) * 1e3, 2),
+            }
+
+        out = []
+        if peers is not None and peers.clients:
+            out = peers._gather(
+                probe,
+                lambda c: {
                     "peer": f"{c.host}:{c.port}",
-                    "ok": ok,
-                    "rtt_ms": round(
-                        (time.monotonic() - t0) * 1e3, 2
-                    ),
-                }
+                    "ok": False,
+                    "rtt_ms": -1.0,
+                },
             )
         return {"endpoint": self.s3.endpoint, "net": out}
 
@@ -458,7 +469,32 @@ class PeerRESTServer:
                 "names": set(doc.get("names") or []),
                 "polled": time.monotonic(),
             }
+            self._ensure_listen_gc_thread()
         return {"ok": True}
+
+    def _ensure_listen_gc_thread(self) -> None:
+        """Background sweeper (held under _listen_mu): reaps orphaned
+        subscriptions even when listen traffic stops entirely (a
+        crashed watcher node never sends another RPC); exits once the
+        table is empty."""
+        t = self._listen_gc_thread
+        if t is not None and t.is_alive():
+            return
+
+        def sweep():
+            while True:
+                time.sleep(self._LISTEN_TTL_S / 2)
+                with self._listen_mu:
+                    self._listen_gc_locked()
+                    if not self._listeners:
+                        self._listen_gc_thread = None
+                        return
+
+        t = threading.Thread(
+            target=sweep, daemon=True, name="peer-listen-gc"
+        )
+        self._listen_gc_thread = t
+        t.start()
 
     def _listen_buf(self, q, body) -> dict:
         """Drain a remote listener's queue: wire-ready notification
@@ -534,7 +570,7 @@ class PeerRESTServer:
         "cpuobdinfo": _cpu_obd,
         "osinfoobdinfo": _os_obd,
         "procobdinfo": _proc_obd,
-        "diskhwobdinfo": _diskhw_obd,
+        "diskhwobdinfo": _drive_obd,  # same slice, alias not copy
         "netobdinfo": _net_obd,
         "dispatchnetobdinfo": _dispatch_net_obd,
         # cluster-wide event listen

@@ -14,6 +14,12 @@ cmd/bitrot-streaming.go interleaved framing) with a TPU-native default:
 Shard blocks are zero-padded to 32-byte multiples (device word/tile
 alignment); the pad is part of the hashed payload, and true lengths are
 recovered from object size metadata at decode.
+
+All algorithms here are INTEGRITY checksums against accidental bitrot,
+not MACs: phash256's keys are public (like the reference's hard-coded
+HighwayHash key, bitrot.go:41-58) and sha256/blake2b are unkeyed, so a
+deliberate on-disk forger is out of scope by design - see the threat
+model in ops/hash.py for the full rationale and the keyed escape hatch.
 """
 
 from __future__ import annotations

@@ -187,6 +187,8 @@ class IAMSys:
         doc = self._load_one_doc("users", access_key)
         if doc is self._ABSENT:
             sts = self._load_one_doc("sts", access_key)
+            if sts is None:
+                return False  # transient failure: keep the cache
             if isinstance(sts, dict) and sts.get(
                 "expiration", 0
             ) > time.time():

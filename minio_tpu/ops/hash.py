@@ -19,6 +19,33 @@ designed for a vector machine instead of 64-bit scalar SIMD:
 
 Host (numpy) and device (jnp) implementations are bit-identical; tests
 assert agreement and corruption-detection properties.
+
+Threat model
+------------
+phash256 defends against ACCIDENTAL corruption only - bit flips from
+decaying media, torn writes, firmware bugs, truncation.  For a random
+flip the two independent 32-bit mixes per word give a miss probability
+of ~2^-64 per partition pair, far below the residual error rate of the
+disks underneath.  It does NOT resist a deliberate forger: the
+position-derived keys (splitmix32 of the word index, line ~55) are
+fixed and public, so an adversary who can write shard bytes can also
+compute matching digests - there is no secret anywhere in the
+construction.  This matches how the reference deploys its bitrot
+hashes: HighwayHash-256 is keyed in principle, but cmd/bitrot.go:41-58
+uses a MAGIC, HARD-CODED key for exactly this role ("hash channel
+separation", not secrecy), so its deployment is equally forgeable and
+both systems treat on-disk tamper-resistance as out of scope (an
+attacker with write access to a drive can rewrite xl.meta wholesale,
+digests included).  Confidentiality/integrity against adversaries is
+layered above: SSE (AES-GCM, authenticated) for object data, signed
+requests for the API plane.
+
+Keyed escape hatch: if a deployment ever needs an unforgeable bitrot
+digest, derive the per-word keys from a secret instead of the public
+index mix - ``key = _mix(idx * _C1 + secret32)`` keeps the same
+O(log n) shape and lane layout; only the key schedule changes.  The
+bitrot registry (codec/bitrot.py) already dispatches per-algorithm, so
+a "phash256k" entry can coexist with stored objects.
 """
 
 from __future__ import annotations

@@ -348,6 +348,15 @@ class S3Server:
         if self._thread:
             self._thread.join(timeout=5)
         self.events.shutdown()
+        # replication workers are per-server threads, not process
+        # singletons: leaving them running after shutdown is a leak
+        # (caught by the tests' leakcheck fixture)
+        repl = getattr(self, "_replication_pool", None)
+        if repl is not None and hasattr(repl, "stop"):
+            try:
+                repl.stop()
+            except Exception:  # noqa: BLE001
+                pass
         # detach the console ring from the shared package logger: a
         # process constructing several servers (tests, embedders) must
         # not accumulate one live handler per dead server

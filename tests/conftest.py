@@ -33,3 +33,57 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long multi-process integration tests"
     )
+
+
+# -- thread/FD leak detector (leak-detect_test.go:30-90) -----------------
+
+import threading as _threading
+
+import pytest as _pytest
+
+
+def _open_fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+# process-lifetime singletons that start lazily on first use and are
+# shared across every server in the process (NOT per-test leaks)
+_LEAK_ALLOW_PREFIXES = ("codec-batcher", "jax", "grpc")
+
+
+@_pytest.fixture()
+def leakcheck():
+    """Snapshot live threads + open fds before the test; after it,
+    poll for convergence back to the baseline (threads need a grace
+    period to drain) and fail on leftovers.  Server-spawning tests
+    opt in by listing this fixture FIRST so its teardown runs last,
+    after the server shutdown."""
+    import time as _time
+
+    before = set(_threading.enumerate())
+    fds_before = _open_fd_count()
+    yield
+    deadline = _time.monotonic() + 10.0
+    leaked: list = []
+    fd_growth = 0
+    while _time.monotonic() < deadline:
+        leaked = [
+            t
+            for t in _threading.enumerate()
+            if t not in before
+            and t.is_alive()
+            and not t.name.startswith(_LEAK_ALLOW_PREFIXES)
+        ]
+        # small tolerance: lazy singletons (logging handles, jax
+        # runtime fds) may open on first use inside the test
+        fd_growth = _open_fd_count() - fds_before
+        if not leaked and fd_growth <= 4:
+            return
+        _time.sleep(0.1)
+    raise AssertionError(
+        "leak detected after test: "
+        f"threads={[t.name for t in leaked]} fd_growth={fd_growth}"
+    )

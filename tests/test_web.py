@@ -18,7 +18,7 @@ RPC = "/minio-tpu/webrpc"
 
 
 @pytest.fixture()
-def server(tmp_path):
+def server(leakcheck, tmp_path):
     disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
     ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
     iam = IAMSys("minioadmin", "minioadmin", ol)
