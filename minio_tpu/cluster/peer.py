@@ -105,7 +105,19 @@ class PeerRESTServer:
         self._listeners: "dict[str, dict]" = {}
         self._listen_mu = threading.Lock()
         self._listen_gc_thread: "threading.Thread | None" = None
+        self._listen_stop = threading.Event()
         self._obd_mu = threading.Lock()
+
+    def close(self) -> None:
+        """Release remote-listener state (sweeper + subscriptions)."""
+        self._listen_stop.set()
+        with self._listen_mu:
+            listeners = list(self._listeners.values())
+            self._listeners.clear()
+        for rec in listeners:
+            self.s3.events.unsubscribe_listener(
+                rec["bucket"], rec["sub"]
+            )
 
     # -- RPC implementations ---------------------------------------------
 
@@ -482,8 +494,7 @@ class PeerRESTServer:
             return
 
         def sweep():
-            while True:
-                time.sleep(self._LISTEN_TTL_S / 2)
+            while not self._listen_stop.wait(self._LISTEN_TTL_S / 2):
                 with self._listen_mu:
                     self._listen_gc_locked()
                     if not self._listeners:

@@ -80,6 +80,11 @@ def _parse_iso(raw: str) -> int:
 
 
 class S3Objects(api.ObjectLayer):
+    # the fronting server forwards customer keys instead of running
+    # its own SSE guards: the upstream owns encryption (_read_info_
+    # and_sse in server/http.py keys off this)
+    sse_passthrough = True
+
     def __init__(self, endpoint: str, access_key: str, secret_key: str,
                  region: str = "us-east-1"):
         self._c = S3UpstreamClient(
@@ -154,6 +159,35 @@ class S3Objects(api.ObjectLayer):
     # -- objects -----------------------------------------------------------
 
     @staticmethod
+    def _sse_headers(sse, copy_source: bool = False) -> dict:
+        """SSE passthrough headers for the upstream (the reference's
+        gateway-s3-sse.go forwards customer keys verbatim; SSE-S3 is
+        one algorithm header - the UPSTREAM owns the encryption)."""
+        if sse is None:
+            return {}
+        if getattr(sse, "mode", "") == "C":
+            import base64 as _b64
+
+            from ..codec import sse as ssemod
+
+            prefix = (
+                "x-amz-copy-source-server-side-encryption-customer"
+                if copy_source
+                else "x-amz-server-side-encryption-customer"
+            )
+            return {
+                f"{prefix}-algorithm": "AES256",
+                f"{prefix}-key": _b64.b64encode(sse.key).decode(),
+                f"{prefix}-key-MD5": ssemod.key_md5_b64(sse.key),
+            }
+        if copy_source:
+            # an SSE-S3 SOURCE needs no request header (the upstream
+            # decrypts transparently); emitting the destination
+            # header here would silently encrypt the destination
+            return {}
+        return {"x-amz-server-side-encryption": "AES256"}
+
+    @staticmethod
     def _meta_headers(metadata: "dict | None") -> dict:
         headers = {}
         for k, v in (metadata or {}).items():
@@ -175,16 +209,16 @@ class S3Objects(api.ObjectLayer):
             return ObjectInfo(
                 bucket=bucket, name=object_name, size=len(data)
             )
-        if sse is not None:
-            raise NotImplementedError("SSE through the S3 gateway")
         if size < 0:
             raise NotImplementedError(
                 "unsized streams through the S3 gateway"
             )
+        headers = self._meta_headers(metadata)
+        headers.update(self._sse_headers(sse))
         st, h, body = self._c.request(
             "PUT",
             f"/{bucket}/{object_name}",
-            headers=self._meta_headers(metadata),
+            headers=headers,
             reader=reader,
             content_length=size,
         )
@@ -196,16 +230,24 @@ class S3Objects(api.ObjectLayer):
             name=object_name,
             size=size,
             etag=hl.get("etag", "").strip('"'),
+            version_id=hl.get("x-amz-version-id", ""),
             user_defined=dict(metadata or {}),
         )
 
-    def _head(self, bucket, object_name) -> "tuple[int, dict]":
+    def _head(
+        self, bucket, object_name, version_id="", sse=None
+    ) -> "tuple[int, dict]":
         st, h, _b = self._c.request(
-            "HEAD", f"/{bucket}/{object_name}"
+            "HEAD",
+            f"/{bucket}/{object_name}",
+            query={"versionId": version_id} if version_id else None,
+            headers=self._sse_headers(sse) or None,
         )
         return st, {k.lower(): v for k, v in h.items()}
 
-    def get_object_info(self, bucket, object_name, version_id=""):
+    def get_object_info(
+        self, bucket, object_name, version_id="", sse=None
+    ):
         check_object_name(object_name)
         if bucket == api.META_BUCKET:
             with self._meta_mu:
@@ -215,10 +257,12 @@ class S3Objects(api.ObjectLayer):
             return ObjectInfo(
                 bucket=bucket, name=object_name, size=len(data)
             )
-        if version_id:
-            raise NotImplementedError("versions through the S3 gateway")
-        st, h = self._head(bucket, object_name)
+        st, h = self._head(bucket, object_name, version_id, sse)
         if st == 404:
+            if version_id:
+                raise api.VersionNotFound(
+                    f"{bucket}/{object_name}@{version_id}"
+                )
             raise api.ObjectNotFound(f"{bucket}/{object_name}")
         if st >= 300:
             raise UpstreamError(st, "UpstreamError", object_name)
@@ -234,6 +278,7 @@ class S3Objects(api.ObjectLayer):
             mod_time_ns=_parse_http_date(h.get("last-modified", "")),
             etag=h.get("etag", "").strip('"'),
             content_type=h.get("content-type", ""),
+            version_id=h.get("x-amz-version-id", ""),
             user_defined=meta,
         )
 
@@ -250,22 +295,23 @@ class S3Objects(api.ObjectLayer):
             return ObjectInfo(
                 bucket=bucket, name=object_name, size=len(data)
             )
-        if version_id:
-            raise NotImplementedError("versions through the S3 gateway")
-        if sse is not None:
-            raise NotImplementedError("SSE through the S3 gateway")
-        headers = {}
+        headers = self._sse_headers(sse)
         if offset or length >= 0:
             end = f"{offset + length - 1}" if length >= 0 else ""
             headers["range"] = f"bytes={offset}-{end}"
         resp = self._c.request(
             "GET",
             f"/{bucket}/{object_name}",
+            query={"versionId": version_id} if version_id else None,
             headers=headers,
             stream_response=True,
         )
         if isinstance(resp, tuple):  # error path: (st, h, body)
             st, _h, body = resp
+            if st == 404 and version_id:
+                raise api.VersionNotFound(
+                    f"{bucket}/{object_name}@{version_id}"
+                )
             self._raise(st, body, f"{bucket}/{object_name}")
         try:
             while True:
@@ -275,7 +321,9 @@ class S3Objects(api.ObjectLayer):
                 writer.write(chunk)
         finally:
             resp.close()
-        return self.get_object_info(bucket, object_name)
+        return self.get_object_info(
+            bucket, object_name, version_id, sse
+        )
 
     def delete_object(self, bucket, object_name, version_id="",
                       versioned=False, version_suspended=False):
@@ -287,24 +335,34 @@ class S3Objects(api.ObjectLayer):
                         f"{bucket}/{object_name}"
                     )
             return ObjectInfo(bucket=bucket, name=object_name)
-        st, _h, body = self._c.request(
-            "DELETE", f"/{bucket}/{object_name}"
+        st, h, body = self._c.request(
+            "DELETE",
+            f"/{bucket}/{object_name}",
+            query={"versionId": version_id} if version_id else None,
         )
         if st not in (200, 204):
             self._raise(st, body, f"{bucket}/{object_name}")
-        return ObjectInfo(bucket=bucket, name=object_name)
+        hl = {k.lower(): v for k, v in h.items()}
+        return ObjectInfo(
+            bucket=bucket,
+            name=object_name,
+            version_id=hl.get("x-amz-version-id", version_id),
+            delete_marker=hl.get("x-amz-delete-marker") == "true",
+        )
 
     def copy_object(self, src_bucket, src_object, dst_bucket,
                     dst_object, metadata=None, versioned=False,
                     sse_src=None, sse=None):
-        if sse is not None or sse_src is not None:
-            raise NotImplementedError("SSE through the S3 gateway")
-        src_info = self.get_object_info(src_bucket, src_object)
+        src_info = self.get_object_info(
+            src_bucket, src_object, sse=sse_src
+        )
         headers = {
             "x-amz-copy-source": urllib.parse.quote(
                 f"/{src_bucket}/{src_object}"
             ),
         }
+        headers.update(self._sse_headers(sse_src, copy_source=True))
+        headers.update(self._sse_headers(sse))
         if metadata is not None:
             headers["x-amz-metadata-directive"] = "REPLACE"
             headers.update(
@@ -381,22 +439,77 @@ class S3Objects(api.ObjectLayer):
         return out
 
     def has_object_versions(self, bucket, object_name) -> bool:
-        return False
+        res = self.list_object_versions(
+            bucket, prefix=object_name, max_keys=2
+        )
+        return any(
+            v.name == object_name and (
+                v.version_id or v.delete_marker
+            )
+            for v in res.versions
+        )
 
-    def list_object_versions(self, *a, **k):
-        raise NotImplementedError("versions through the S3 gateway")
+    def list_object_versions(
+        self, bucket, prefix="", key_marker="", version_id_marker="",
+        delimiter="", max_keys=1000,
+    ):
+        """Pass-through ListObjectVersions (?versions) with the
+        upstream's XML mapped onto the layer's result shape."""
+        q = {"versions": "", "max-keys": str(max_keys)}
+        if prefix:
+            q["prefix"] = prefix
+        if key_marker:
+            q["key-marker"] = key_marker
+        if version_id_marker:
+            q["version-id-marker"] = version_id_marker
+        if delimiter:
+            q["delimiter"] = delimiter
+        st, _h, body = self._c.request("GET", f"/{bucket}", query=q)
+        if st != 200:
+            self._raise(st, body, bucket)
+        root = ET.fromstring(body)
+        out = api.ListObjectVersionsInfo(
+            is_truncated=_find(root, "IsTruncated") == "true",
+            next_key_marker=_find(root, "NextKeyMarker"),
+            next_version_id_marker=_find(
+                root, "NextVersionIdMarker"
+            ),
+        )
+        for el in root:
+            tag = el.tag.rsplit("}", 1)[-1]
+            if tag == "CommonPrefixes":
+                out.prefixes.append(_find(el, "Prefix"))
+                continue
+            if tag not in ("Version", "DeleteMarker"):
+                continue
+            vid = _find(el, "VersionId")
+            out.versions.append(
+                ObjectInfo(
+                    bucket=bucket,
+                    name=_find(el, "Key"),
+                    size=int(_find(el, "Size") or 0),
+                    etag=_find(el, "ETag").strip('"'),
+                    mod_time_ns=_parse_iso(
+                        _find(el, "LastModified")
+                    ),
+                    version_id="" if vid == "null" else vid,
+                    is_latest=_find(el, "IsLatest") == "true",
+                    delete_marker=tag == "DeleteMarker",
+                )
+            )
+        return out
 
     # -- multipart ---------------------------------------------------------
 
     def new_multipart_upload(self, bucket, object_name, metadata=None,
                              sse=None):
-        if sse is not None:
-            raise NotImplementedError("SSE through the S3 gateway")
+        headers = self._meta_headers(metadata)
+        headers.update(self._sse_headers(sse))
         st, _h, body = self._c.request(
             "POST",
             f"/{bucket}/{object_name}",
             query={"uploads": ""},
-            headers=self._meta_headers(metadata),
+            headers=headers,
         )
         if st != 200:
             self._raise(st, body, f"{bucket}/{object_name}")
@@ -404,8 +517,6 @@ class S3Objects(api.ObjectLayer):
 
     def put_object_part(self, bucket, object_name, upload_id,
                         part_number, reader, size=-1, sse=None):
-        if sse is not None:
-            raise NotImplementedError("SSE through the S3 gateway")
         if size < 0:
             raise NotImplementedError(
                 "unsized parts through the S3 gateway"
@@ -417,6 +528,7 @@ class S3Objects(api.ObjectLayer):
                 "uploadId": upload_id,
                 "partNumber": str(part_number),
             },
+            headers=self._sse_headers(sse) or None,
             reader=reader,
             content_length=size,
         )

@@ -474,6 +474,45 @@ class IAMSys:
         self._save_doc("sts", ak, doc)
         return {"access_key": ak, **doc}
 
+    def assume_role_with_token(
+        self,
+        policy: str,
+        duration_s: "int | None" = None,
+        subject: str = "",
+    ) -> dict:
+        """Temp credential for a federated identity: carries its OWN
+        policy attachment instead of a parent user (the OpenID STS
+        path, sts-handlers.go:293-443).  Every named policy must
+        exist; multiple arrive comma-joined and any allow wins."""
+        if duration_s is None:
+            duration_s = STS_DEFAULT_DURATION_S
+        if not (STS_MIN_DURATION_S <= duration_s <= STS_MAX_DURATION_S):
+            raise IAMError(
+                f"DurationSeconds {duration_s} out of range "
+                f"[{STS_MIN_DURATION_S}, {STS_MAX_DURATION_S}]"
+            )
+        if not policy:
+            raise IAMError("federated credential needs a policy claim")
+        for name in policy.split(","):
+            self.get_policy(name)  # must exist (PolicyNotFound)
+        ak, sk = generate_credentials()
+        token = pysecrets.token_urlsafe(48)
+        doc = {
+            "secret": sk,
+            "policy": policy,
+            "status": "enabled",
+            "parent": "",
+            "sts": True,
+            "expiration": time.time() + duration_s,
+            "session_token": token,
+            "session_policy": "",
+            "oidc_sub": subject,
+        }
+        with self._mu:
+            self._users[ak] = doc
+        self._save_doc("sts", ak, doc)
+        return {"access_key": ak, **doc}
+
     def purge_expired_sts(self) -> int:
         """Drop expired temp credentials (lazy GC; returns count)."""
         now = time.time()
@@ -601,7 +640,10 @@ class IAMSys:
                 return False
             pnames = []
             if u.get("policy"):
-                pnames.append(u["policy"])
+                # federated creds may carry several comma-joined names
+                pnames.extend(
+                    p for p in u["policy"].split(",") if p
+                )
             for g in self._groups.values():
                 if (
                     account in g.get("members", ())
@@ -635,9 +677,13 @@ class IAMSys:
                 except PolicyError:
                     return False
             parent = u.get("parent", "")
-            if self.is_owner(parent):
-                return True
-            return self._base_allowed(parent, args)
+            if parent:
+                if self.is_owner(parent):
+                    return True
+                return self._base_allowed(parent, args)
+            # parentless federated credential (OpenID STS): its own
+            # attached policy IS the whole identity
+            return self._base_allowed(args.account, args)
         # service accounts inherit the parent's effective policy
         parent = u.get("parent")
         if parent:

@@ -383,6 +383,7 @@ def main(argv=None) -> int:
         local_locker=lock_rest.locker if lock_rest is not None else None,
     )
     srv.register_internode(peer_mod.PREFIX, peer_rest.handle)
+    srv.peer_rest = peer_rest  # shutdown() closes its sweeper
     srv.local_locker = lock_rest.locker if lock_rest is not None else None
     if peers:
         srv.peer_notifier = peer_mod.PeerNotifier(peers)

@@ -357,6 +357,12 @@ class S3Server:
                 repl.stop()
             except Exception:  # noqa: BLE001
                 pass
+        peer_rest = getattr(self, "peer_rest", None)
+        if peer_rest is not None and hasattr(peer_rest, "close"):
+            try:
+                peer_rest.close()
+            except Exception:  # noqa: BLE001
+                pass
         # detach the console ring from the shared package logger: a
         # process constructing several servers (tests, embedders) must
         # not accumulate one live handler per dead server
@@ -1695,6 +1701,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.s3.object_layer.get_bucket_info(bucket)
         try:
             tags = tagmod.from_xml(body, tagmod.MAX_BUCKET_TAGS)
+        except tagmod.TagXMLError as e:
+            raise S3Error("MalformedXML", str(e)) from None
         except tagmod.TagError as e:
             raise S3Error("InvalidTag", str(e)) from None
         self.s3.bucket_meta.update(
@@ -1851,6 +1859,8 @@ class _Handler(BaseHTTPRequestHandler):
             tags = tagmod.from_xml(
                 self._read_body(), tagmod.MAX_OBJECT_TAGS
             )
+        except tagmod.TagXMLError as e:
+            raise S3Error("MalformedXML", str(e)) from None
         except tagmod.TagError as e:
             raise S3Error("InvalidTag", str(e)) from None
         self.s3.object_layer.update_object_meta(
@@ -2219,8 +2229,7 @@ class _Handler(BaseHTTPRequestHandler):
         block-by-block into wfile - constant memory per request."""
         ol = self.s3.object_layer
         version_id = query.get("versionId", [""])[0]
-        info = ol.get_object_info(bucket, key, version_id)
-        sse = self._read_sse(info)
+        info, sse = self._read_info_and_sse(ol, bucket, key, version_id)
         self._check_conditions(info)
         rng = self._parse_range(info.size)
         headers = self._object_headers(info)
@@ -2271,10 +2280,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _head_object(self, bucket, key, query):
         version_id = query.get("versionId", [""])[0]
-        info = self.s3.object_layer.get_object_info(
-            bucket, key, version_id
-        )
-        self._read_sse(info)  # key required (and checked) for HEAD too
+        info, _sse = self._read_info_and_sse(
+            self.s3.object_layer, bucket, key, version_id
+        )  # key required (and checked) for HEAD too
         self._check_conditions(info)
         headers = self._object_headers(info)
         headers.update(self._sse_response_headers(info.user_defined))
@@ -2479,6 +2487,9 @@ class _Handler(BaseHTTPRequestHandler):
         (object-handlers.go:102)."""
         from ..codec import sse as ssemod
 
+        passthrough = getattr(
+            self.s3.object_layer, "sse_passthrough", False
+        )
         spec = self._parse_ssec_headers(
             "x-amz-server-side-encryption-customer"
         )
@@ -2498,7 +2509,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "InvalidRequest",
                     "The encryption method specified is not supported",
                 )
-            if not ssemod.sse_s3_available():
+            if not passthrough and not ssemod.sse_s3_available():
+                # a gateway only forwards the header; the UPSTREAM's
+                # KMS does the work, so no local KMS is needed
                 raise S3Error(
                     "InvalidArgument",
                     "Server side encryption specified but KMS is not "
@@ -2512,7 +2525,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception:  # noqa: BLE001
             raw = ""
         if raw and self._default_sse_algo(raw) == "AES256":
-            if not ssemod.sse_s3_available():
+            if not passthrough and not ssemod.sse_s3_available():
                 # the bucket DEMANDS encryption: storing plaintext
                 # because the KMS went away would silently violate it
                 raise S3Error(
@@ -2535,6 +2548,37 @@ class _Handler(BaseHTTPRequestHandler):
             if el.tag.split("}")[-1] == "SSEAlgorithm":
                 return (el.text or "").strip()
         return ""
+
+    def _copy_source_info_and_sse(self, src_bucket, src_key):
+        """(src_info, source read-spec) for copy operations; gateway
+        layers forward the copy-source customer key to the upstream
+        instead of running local SSE guards (like _read_info_and_sse
+        for GET/HEAD)."""
+        ol = self.s3.object_layer
+        if getattr(ol, "sse_passthrough", False):
+            spec = self._parse_ssec_headers(
+                "x-amz-copy-source-server-side-encryption-customer"
+            )
+            info = ol.get_object_info(src_bucket, src_key, sse=spec)
+            return info, spec
+        info = ol.get_object_info(src_bucket, src_key)
+        return info, self._read_sse(info, copy_source=True)
+
+    def _read_info_and_sse(self, ol, bucket, key, version_id):
+        """(info, read-spec) for a GET/HEAD.  Gateway layers do SSE
+        pass-through: the UPSTREAM owns encryption, so the request's
+        customer key rides the gateway HEAD/GET verbatim and the
+        local _read_sse guards do not apply (gateway-s3-sse.go)."""
+        if getattr(ol, "sse_passthrough", False):
+            spec = self._parse_ssec_headers(
+                "x-amz-server-side-encryption-customer"
+            )
+            info = ol.get_object_info(
+                bucket, key, version_id, sse=spec
+            )
+            return info, spec
+        info = ol.get_object_info(bucket, key, version_id)
+        return info, self._read_sse(info)
 
     def _read_sse(self, info, copy_source: bool = False):
         """Spec needed to READ ``info``; enforces that SSE-C objects
@@ -2619,10 +2663,9 @@ class _Handler(BaseHTTPRequestHandler):
         # (code-review r4: copy must not bypass either)
         from ..objectlayer import quota as quotamod
 
-        src_info = self.s3.object_layer.get_object_info(
+        src_info, sse_src = self._copy_source_info_and_sse(
             src_bucket, src_key
         )
-        sse_src = self._read_sse(src_info, copy_source=True)
         sse_dst = self._request_sse(bucket)
         quotamod.enforce_put(self.s3, bucket, src_info.size)
         replicate = self.s3.replication.should_replicate(bucket, key)
@@ -2783,8 +2826,9 @@ class _Handler(BaseHTTPRequestHandler):
             raise S3Error("InvalidArgument", "partNumber") from None
         src_bucket, src_key = self._parse_copy_source()
         ol = self.s3.object_layer
-        src_info = ol.get_object_info(src_bucket, src_key)
-        sse_src = self._read_sse(src_info, copy_source=True)
+        src_info, sse_src = self._copy_source_info_and_sse(
+            src_bucket, src_key
+        )
         part_sse = self._parse_ssec_headers(
             "x-amz-server-side-encryption-customer"
         )

@@ -14,6 +14,7 @@ from ..objectlayer import api as olapi
 from ..storage import errors as serrors
 from ..utils.hashreader import BadDigest, SizeMismatch
 from .auth import AuthError
+from .s3errors_table import VARIANTS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,9 +63,9 @@ _E = {
     "SignatureDoesNotMatch": ("The request signature we calculated does not match the signature you provided.", H.FORBIDDEN),
     "SignatureVersionNotSupported": ("The authorization mechanism you have provided is not supported.", H.BAD_REQUEST),
     "ServerNotInitialized": ("Server not initialized, please try again.", H.SERVICE_UNAVAILABLE),
-    "HealAlreadyRunning": ("Heal is already running on the given path", H.CONFLICT),
-    "HealOverlappingPaths": ("The heal path overlaps with a running heal sequence", H.CONFLICT),
-    "HealNoSuchProcess": ("No heal sequence exists on the given path", H.NOT_FOUND),
+    "HealAlreadyRunning": ("Heal is already running on the given path", H.BAD_REQUEST),
+    "HealOverlappingPaths": ("The heal path overlaps with a running heal sequence", H.BAD_REQUEST),
+    "HealNoSuchProcess": ("No heal sequence exists on the given path", H.BAD_REQUEST),
     "HealInvalidClientToken": ("Client token mismatch for the heal sequence", H.BAD_REQUEST),
     "OperationTimedOut": ("A timeout occurred while trying to lock a resource, please reduce your request rate", H.SERVICE_UNAVAILABLE),
     "SlowDown": ("Resource requested is unreadable, please reduce your request rate", H.SERVICE_UNAVAILABLE),
@@ -202,8 +203,45 @@ _E = {
 }
 
 
+# keys whose WIRE code differs from the key (matching the reference's
+# Code strings exactly - mc/madmin/SDKs dispatch on these); the key
+# names stay stable for in-tree raisers
+_WIRE = {
+    "SignatureVersionNotSupported": "InvalidRequest",
+    "RequestNotReadyYet": "AccessDenied",
+    "InvalidBucketObjectLockConfiguration": "InvalidRequest",
+    "ObjectLocked": "InvalidRequest",
+    "InvalidRetentionDate": "InvalidRequest",
+    "PastObjectLockRetainDate": "InvalidRequest",
+    "UnknownWORMModeDirective": "InvalidRequest",
+    "ObjectLockInvalidHeaders": "InvalidRequest",
+    "InvalidTagDirective": "InvalidArgument",
+    "ServerNotInitialized": "XMinioServerNotInitialized",
+    "OperationTimedOut": "RequestTimeout",
+    "HealNoSuchProcess": "XMinioHealNoSuchProcess",
+    "HealInvalidClientToken": "XMinioHealInvalidClientToken",
+    "HealAlreadyRunning": "XMinioHealAlreadyRunning",
+    "HealOverlappingPaths": "XMinioHealOverlappingPaths",
+    "EvaluatorBindingDoesNotExist": "ErrEvaluatorBindingDoesNotExist",
+}
+
+
 def get(code: str, message: str = "") -> APIError:
-    msg, status = _E.get(code, _E["InternalError"])
+    """APIError for a code key.  Keys are usually the wire code; the
+    fine-grained reference conditions (ErrInvalidCopyDest, ...) that
+    REUSE a wire code live in s3errors_table.VARIANTS under their
+    internal names and resolve to (wire code, own message)."""
+    hit = _E.get(code)
+    if hit is not None:
+        msg, status = hit
+        return APIError(
+            _WIRE.get(code, code), message or msg, int(status)
+        )
+    var = VARIANTS.get(code)
+    if var is not None:
+        wire, msg, status = var
+        return APIError(wire, message or msg, int(status))
+    msg, status = _E["InternalError"]
     return APIError(code, message or msg, int(status))
 
 
@@ -234,6 +272,26 @@ def from_exception(e: Exception) -> APIError:
     if isinstance(e, NotImplementedError):
         # backend without the capability (FS versioning, gateways)
         return get("NotImplemented", str(e) or "")
+    try:
+        from ..gateway.client import UpstreamError
+    except ImportError:
+        UpstreamError = ()  # type: ignore[assignment]
+    if isinstance(e, UpstreamError):
+        # pass the upstream's verdict through with ITS status class
+        # instead of collapsing every gateway failure into a 500
+        # (gateway ErrorRespToObjectError, gateway-common.go)
+        code = {
+            400: "InvalidRequest",
+            403: "AccessDenied",
+            404: "NoSuchKey",
+            409: "OperationAborted",
+            503: "SlowDown",
+        }.get(e.status)
+        if e.code and e.code != "UpstreamError" and e.code in _E:
+            return get(e.code, str(e))
+        if code:
+            return get(code, str(e))
+        return get("InternalError", str(e))
     mapping = [
         (olapi.BucketNotFound, "NoSuchBucket"),
         (olapi.BucketExists, "BucketAlreadyOwnedByYou"),

@@ -35,11 +35,12 @@ def handle_sts(handler, form: "dict[str, str]") -> None:
     if action in (
         "AssumeRoleWithWebIdentity",
         "AssumeRoleWithClientGrants",
-        "AssumeRoleWithLDAPIdentity",
     ):
+        return _handle_sts_oidc(handler, form, action)
+    if action == "AssumeRoleWithLDAPIdentity":
         raise S3Error(
             "NotImplemented",
-            f"{action} requires an external identity provider",
+            f"{action} requires an external LDAP provider",
         )
     if action != "AssumeRole":
         raise S3Error("InvalidParameterValue", f"unknown Action {action!r}")
@@ -87,5 +88,110 @@ def handle_sts(handler, form: "dict[str, str]") -> None:
         "</AssumeRoleResult>"
         "<ResponseMetadata/>"
         "</AssumeRoleResponse>"
+    ).encode()
+    handler._respond(200, body)
+
+
+def _handle_sts_oidc(handler, form: "dict[str, str]", action: str):
+    """AssumeRoleWithWebIdentity / AssumeRoleWithClientGrants
+    (sts-handlers.go:293-443): validate the provider-issued JWT, read
+    the policy claim, mint a parentless temp credential carrying that
+    policy.  Unsigned requests are allowed - the token IS the proof."""
+    from ..iam import openid
+    from ..iam.sys import PolicyNotFound
+
+    if form.get("Version", "") != STS_VERSION:
+        raise S3Error(
+            "InvalidParameterValue", f"Version must be {STS_VERSION}"
+        )
+    validator = openid.get_validator()
+    if validator is None:
+        raise S3Error(
+            "NotImplemented",
+            f"{action} requires an OpenID provider "
+            f"(set {openid.ENV_CONFIG_URL})",
+        )
+    token_field = (
+        "WebIdentityToken"
+        if action == "AssumeRoleWithWebIdentity"
+        else "Token"
+    )
+    token = form.get(token_field, "")
+    if not token:
+        raise S3Error("InvalidParameterValue", f"missing {token_field}")
+    try:
+        claims = validator.validate(token)
+    except openid.OpenIDError as e:
+        raise S3Error("AccessDenied", f"invalid token: {e}") from None
+    try:
+        policy = validator.policy_claim(claims)
+    except openid.OpenIDError as e:
+        raise S3Error("AccessDenied", str(e)) from None
+    # the credential must NEVER outlive the identity token: an
+    # explicit DurationSeconds is capped at the token's remaining
+    # validity, and a token with less than the minimum left is
+    # rejected outright (flooring it up would mint creds that
+    # outlive the identity provider's session)
+    import time as _time
+
+    from ..iam.sys import STS_MAX_DURATION_S, STS_MIN_DURATION_S
+
+    remaining = None
+    if isinstance(claims.get("exp"), (int, float)):
+        remaining = int(claims["exp"] - _time.time())
+        if remaining < STS_MIN_DURATION_S:
+            raise S3Error(
+                "AccessDenied",
+                "token expires too soon for a temporary credential",
+            )
+    duration = None
+    if form.get("DurationSeconds"):
+        try:
+            duration = int(form["DurationSeconds"])
+        except ValueError:
+            raise S3Error(
+                "InvalidParameterValue", "DurationSeconds"
+            ) from None
+        if remaining is not None:
+            duration = min(duration, remaining)
+    elif remaining is not None:
+        duration = min(remaining, STS_MAX_DURATION_S)
+    iam = handler.s3.iam
+    try:
+        cred = iam.assume_role_with_token(
+            policy, duration_s=duration,
+            subject=str(claims.get("sub", "")),
+        )
+    except PolicyNotFound as e:
+        raise S3Error(
+            "AccessDenied", f"policy claim names an unknown policy: {e}"
+        ) from None
+    except IAMError as e:
+        raise S3Error("InvalidParameterValue", str(e)) from None
+    exp = datetime.datetime.fromtimestamp(
+        cred["expiration"], datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+    result = f"{action}Result"
+    subject_el = (
+        "<SubjectFromWebIdentityToken>"
+        f"{sx.escape(str(claims.get('sub', '')))}"
+        "</SubjectFromWebIdentityToken>"
+        if action == "AssumeRoleWithWebIdentity"
+        else ""
+    )
+    body = (
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        f'<{action}Response xmlns="{_NS}">'
+        f"<{result}>"
+        f"{subject_el}"
+        "<Credentials>"
+        f"<AccessKeyId>{sx.escape(cred['access_key'])}</AccessKeyId>"
+        f"<SecretAccessKey>{sx.escape(cred['secret'])}</SecretAccessKey>"
+        f"<SessionToken>{sx.escape(cred['session_token'])}</SessionToken>"
+        f"<Expiration>{exp}</Expiration>"
+        "</Credentials>"
+        f"</{result}>"
+        "<ResponseMetadata/>"
+        f"</{action}Response>"
     ).encode()
     handler._respond(200, body)

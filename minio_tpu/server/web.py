@@ -355,7 +355,9 @@ def _upload(h, bucket: str, obj: str) -> None:
     h._respond(200, b"", {"ETag": f'"{info.etag}"'})
 
 
-def _download(h, bucket: str, obj: str, query) -> None:
+def _verify_url_token(h, query) -> dict:
+    """Shared URL-token check for download/zip endpoints: a login
+    token is NOT a download token (web-handlers.go URL token)."""
     token = query.get("token", [""])[0]
     try:
         claims = jwt.verify(token, h.s3.iam.root_secret_key)
@@ -363,6 +365,11 @@ def _download(h, bucket: str, obj: str, query) -> None:
         raise S3Error("AccessDenied", f"bad token: {e}") from None
     if not claims.get("web-url-token"):
         raise S3Error("AccessDenied", "not a download token")
+    return claims
+
+
+def _download(h, bucket: str, obj: str, query) -> None:
+    claims = _verify_url_token(h, query)
     try:
         _allow(h, claims.get("sub", ""), "s3:GetObject", bucket, obj)
     except WebError:
@@ -401,6 +408,97 @@ def _download(h, bucket: str, obj: str, query) -> None:
         h._resp_bytes += info.size
 
 
+def _download_zip(h, query) -> None:
+    """DownloadZip (web-handlers.go:1290): POST a JSON document
+    ``{"bucketName": b, "prefix": p, "objects": [...]}`` with a URL
+    token; objects ending in '/' expand recursively.  The archive is
+    streamed - zipfile writes straight into the chunked response, so
+    memory stays bounded per object block."""
+    import zipfile
+
+    claims = _verify_url_token(h, query)
+    try:
+        args = json.loads(h._read_body() or b"{}")
+    except ValueError:
+        raise S3Error("InvalidRequest", "bad JSON body") from None
+    bucket = args.get("bucketName", "")
+    prefix = args.get("prefix", "")
+    objects = args.get("objects") or []
+    if not bucket or not objects:
+        raise S3Error("InvalidRequest", "bucketName and objects required")
+    account = claims.get("sub", "")
+    ol = h.s3.object_layer
+    from ..codec import sse as ssemod
+
+    # expand prefixes + permission-check every entry BEFORE headers
+    names: "list[str]" = []
+    for obj in objects:
+        full = prefix + obj
+        if full.endswith("/") or full == "":
+            marker = ""
+            while True:
+                res = ol.list_objects(
+                    bucket, full, marker, "", 1000
+                )
+                names.extend(o.name for o in res.objects)
+                if not res.is_truncated:
+                    break
+                marker = res.next_marker
+        else:
+            names.append(full)
+    for name in names:
+        try:
+            _allow(h, account, "s3:GetObject", bucket, name)
+        except WebError:
+            raise S3Error("AccessDenied") from None
+        info = ol.get_object_info(bucket, name)
+        if (info.user_defined or {}).get(ssemod.META_SSE) == "C":
+            raise S3Error(
+                "InvalidRequest",
+                "zip download cannot read SSE-C objects",
+            )
+    h.send_response(200)
+    h.send_header("Server", "MinIO-TPU")
+    h.send_header("Content-Type", "application/zip")
+    h.send_header(
+        "Content-Disposition", 'attachment; filename="download.zip"'
+    )
+    h.send_header("Transfer-Encoding", "chunked")
+    h.end_headers()
+    h._headers_sent = True
+    h._last_status = 200
+
+    class _Chunked:
+        """Chunked-transfer writer (length unknown up front)."""
+
+        def write(self, b: bytes) -> int:
+            if b:
+                h.wfile.write(f"{len(b):x}\r\n".encode())
+                h.wfile.write(b)
+                h.wfile.write(b"\r\n")
+                h._resp_bytes += len(b)
+            return len(b)
+
+        def flush(self):
+            h.wfile.flush()
+
+    out = _Chunked()
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as zf:
+        for name in names:
+            # archive paths are relative to the requested prefix
+            arcname = name[len(prefix):] if name.startswith(
+                prefix
+            ) else name
+            zi = zipfile.ZipInfo(arcname or name)
+            # ZipFile's compression arg does NOT apply to handed-in
+            # ZipInfo objects (they default to STORED)
+            zi.compress_type = zipfile.ZIP_DEFLATED
+            with zf.open(zi, "w", force_zip64=True) as entry:
+                ol.get_object(bucket, name, entry)
+    h.wfile.write(b"0\r\n\r\n")
+    h.wfile.flush()
+
+
 CONSOLE_PATH = "/minio-tpu/console"
 
 
@@ -430,4 +528,6 @@ def handle(h, path: str, query) -> None:
         return _download(
             h, parts[1], urllib.parse.unquote(parts[2]), query
         )
+    if parts[0] == "zip" and h.command == "POST":
+        return _download_zip(h, query)
     raise S3Error("MethodNotAllowed")

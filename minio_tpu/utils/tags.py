@@ -24,6 +24,11 @@ class TagError(Exception):
     pass
 
 
+class TagXMLError(TagError):
+    """Unparseable document: MalformedXML on the wire, not InvalidTag
+    (AWS distinguishes schema failure from tag-content failure)."""
+
+
 def validate(tags: "dict[str, str]", limit: int) -> None:
     if len(tags) > limit:
         raise TagError(f"too many tags (max {limit})")
@@ -39,9 +44,9 @@ def from_xml(body: bytes, limit: int) -> "dict[str, str]":
     try:
         root = ET.fromstring(body)
     except ET.ParseError:
-        raise TagError("malformed XML") from None
+        raise TagXMLError("malformed XML") from None
     if _strip_ns(root.tag) != "Tagging":
-        raise TagError("not a Tagging document")
+        raise TagXMLError("not a Tagging document")
     tags: dict[str, str] = {}
     for el in root.iter():
         if _strip_ns(el.tag) != "Tag":

@@ -162,13 +162,14 @@ def test_admin_heal_sequence_e2e(tmp_path):
             query={"bucket": "healb", "clientToken": "nope"},
         )
         assert r.status == 400
-        assert r.error_code == "HealInvalidClientToken"
-        # no sequence on an unknown path -> 404
+        assert r.error_code == "XMinioHealInvalidClientToken"
+        # no sequence on an unknown path -> 400 (madmin wire parity)
         r = c.request(
             "POST", f"{ADMIN}/heal-sequence",
             query={"bucket": "healb", "prefix": "zz/", "clientToken": "x"},
         )
-        assert r.status == 404
+        assert r.status == 400
+        assert r.error_code == "XMinioHealNoSuchProcess"
     finally:
         srv.shutdown()
 

@@ -1,0 +1,400 @@
+"""STS OpenID federation: AssumeRoleWithWebIdentity / ClientGrants
+against an in-process OIDC stub (sts-handlers.go:293-443,
+pkg/iam/openid validator).
+
+The stub IdP serves a real discovery document + JWKS over HTTP and
+issues RS256 tokens signed with a locally generated RSA key, so the
+whole chain - JWKS fetch, signature verification, claim extraction,
+temp-credential issue, authorized object CRUD - runs for real.
+"""
+
+import base64
+import hashlib
+import json
+import secrets
+import threading
+import time
+
+import pytest
+
+from minio_tpu.iam import openid
+from minio_tpu.iam.policy import Policy
+from minio_tpu.iam.sys import IAMSys
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+
+# -- minimal RSA (test-only; 1024-bit is plenty for a stub IdP) ---------
+
+
+def _is_probable_prime(n: int, rounds: int = 24) -> bool:
+    if n < 2:
+        return False
+    for p in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for _ in range(rounds):
+        a = secrets.randbelow(n - 3) + 2
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = pow(x, 2, n)
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _gen_prime(bits: int) -> int:
+    while True:
+        c = secrets.randbits(bits) | (1 << (bits - 1)) | 1
+        if _is_probable_prime(c):
+            return c
+
+
+def _gen_rsa(bits: int = 1024):
+    e = 65537
+    while True:
+        p, q = _gen_prime(bits // 2), _gen_prime(bits // 2)
+        if p == q:
+            continue
+        n = p * q
+        phi = (p - 1) * (q - 1)
+        if phi % e == 0:
+            continue
+        d = pow(e, -1, phi)
+        return n, e, d
+
+
+def _b64u(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+_KEY = _gen_rsa()  # one keypair for the whole module
+
+
+class StubIdP:
+    """In-process OIDC provider: discovery + JWKS + token mint."""
+
+    def __init__(self):
+        import http.server
+
+        self.n, self.e, self.d = _KEY
+        self.kid = "stub-key-1"
+        idp = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/.well-known/openid-configuration":
+                    doc = {
+                        "issuer": idp.issuer,
+                        "jwks_uri": f"{idp.issuer}/jwks",
+                    }
+                elif self.path == "/jwks":
+                    doc = {
+                        "keys": [
+                            {
+                                "kty": "RSA",
+                                "kid": idp.kid,
+                                "alg": "RS256",
+                                "n": _b64u(
+                                    idp.n.to_bytes(
+                                        (idp.n.bit_length() + 7) // 8,
+                                        "big",
+                                    )
+                                ),
+                                "e": _b64u(
+                                    idp.e.to_bytes(3, "big")
+                                ),
+                            }
+                        ]
+                    }
+                else:
+                    self.send_error(404)
+                    return
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        import socketserver
+
+        self._httpd = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), H
+        )
+        self._httpd.daemon_threads = True
+        self.issuer = (
+            f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        )
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def token(self, claims: dict, kid=None, corrupt=False) -> str:
+        header = {"alg": "RS256", "typ": "JWT", "kid": kid or self.kid}
+        base = dict(claims)
+        base.setdefault("iss", self.issuer)
+        base.setdefault("exp", time.time() + 3600)
+        signing = (
+            _b64u(json.dumps(header).encode())
+            + "."
+            + _b64u(json.dumps(base).encode())
+        )
+        prefix = bytes.fromhex(
+            "3031300d060960864801650304020105000420"
+        )
+        k = (self.n.bit_length() + 7) // 8
+        digest = hashlib.sha256(signing.encode()).digest()
+        em = (
+            b"\x00\x01"
+            + b"\xff" * (k - 3 - len(prefix) - 32)
+            + b"\x00"
+            + prefix
+            + digest
+        )
+        sig = pow(
+            int.from_bytes(em, "big"), self.d, self.n
+        ).to_bytes(k, "big")
+        if corrupt:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        return signing + "." + _b64u(sig)
+
+
+@pytest.fixture(scope="module")
+def idp():
+    s = StubIdP()
+    yield s
+    s.close()
+
+
+@pytest.fixture()
+def validator(idp):
+    return openid.OpenIDValidator(
+        f"{idp.issuer}/.well-known/openid-configuration",
+        client_id="minio-tpu-app",
+    )
+
+
+# -- validator unit behavior -------------------------------------------
+
+
+def test_valid_token_accepted(idp, validator):
+    claims = validator.validate(
+        idp.token({"sub": "u1", "aud": "minio-tpu-app"})
+    )
+    assert claims["sub"] == "u1"
+
+
+def test_bad_signature_rejected(idp, validator):
+    with pytest.raises(openid.OpenIDError, match="signature"):
+        validator.validate(
+            idp.token({"aud": "minio-tpu-app"}, corrupt=True)
+        )
+
+
+def test_expired_token_rejected(idp, validator):
+    with pytest.raises(openid.OpenIDError, match="expired"):
+        validator.validate(
+            idp.token(
+                {"aud": "minio-tpu-app", "exp": time.time() - 10}
+            )
+        )
+
+
+def test_wrong_audience_rejected(idp, validator):
+    with pytest.raises(openid.OpenIDError, match="audience"):
+        validator.validate(idp.token({"aud": "someone-else"}))
+
+
+def test_wrong_issuer_rejected(idp, validator):
+    with pytest.raises(openid.OpenIDError, match="issuer"):
+        validator.validate(
+            idp.token({"aud": "minio-tpu-app", "iss": "http://evil"})
+        )
+
+
+def test_policy_claim_extraction(validator):
+    assert validator.policy_claim({"policy": "readwrite"}) == (
+        "readwrite"
+    )
+    assert validator.policy_claim(
+        {"policy": ["p1", "p2"]}
+    ) == "p1,p2"
+    assert validator.policy_claim({"policy": "a, b"}) == "a,b"
+    with pytest.raises(openid.OpenIDError):
+        validator.policy_claim({"other": "x"})
+
+
+# -- end to end through the server -------------------------------------
+
+
+@pytest.fixture()
+def server(leakcheck, idp, tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        openid.ENV_CONFIG_URL,
+        f"{idp.issuer}/.well-known/openid-configuration",
+    )
+    monkeypatch.setenv(openid.ENV_CLIENT_ID, "minio-tpu-app")
+    openid.reset_validator_cache()
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
+    iam = IAMSys("minioadmin", "minioadmin", ol)
+    srv = S3Server(ol, address="127.0.0.1:0", iam=iam).start()
+    yield srv
+    srv.shutdown()
+    openid.reset_validator_cache()
+
+
+def _sts_oidc(server, action, token_field, token, extra=None):
+    import urllib.parse
+
+    form = {
+        "Action": action,
+        "Version": "2011-06-15",
+        token_field: token,
+        **(extra or {}),
+    }
+    c = S3Client(server.endpoint)
+    return c.request(
+        "POST", "/",
+        body=urllib.parse.urlencode(form).encode(),
+        headers={
+            "Content-Type": "application/x-www-form-urlencoded"
+        },
+        sign=False,
+    )
+
+
+def _creds_from(body: bytes):
+    import re
+
+    ak = re.search(rb"<AccessKeyId>([^<]+)", body).group(1).decode()
+    sk = re.search(
+        rb"<SecretAccessKey>([^<]+)", body
+    ).group(1).decode()
+    st = re.search(
+        rb"<SessionToken>([^<]+)", body
+    ).group(1).decode()
+    return ak, sk, st
+
+
+@pytest.mark.parametrize(
+    "action,field",
+    [
+        ("AssumeRoleWithWebIdentity", "WebIdentityToken"),
+        ("AssumeRoleWithClientGrants", "Token"),
+    ],
+)
+def test_oidc_sts_end_to_end(server, idp, action, field):
+    """A stub-IdP token buys working temp creds that pass object CRUD
+    under the claimed policy - and nothing more."""
+    server.iam.set_policy(
+        "oidc-rw",
+        Policy.from_dict(
+            {
+                "Version": "2012-10-17",
+                "Statement": [
+                    {
+                        "Effect": "Allow",
+                        "Action": ["s3:*"],
+                        "Resource": [
+                            "arn:aws:s3:::fedbkt",
+                            "arn:aws:s3:::fedbkt/*",
+                        ],
+                    }
+                ],
+            }
+        ),
+    )
+    root = S3Client(server.endpoint)
+    assert root.make_bucket("fedbkt").status == 200
+    assert root.make_bucket("otherbkt").status == 200
+
+    r = _sts_oidc(
+        server, action, field,
+        idp.token(
+            {
+                "sub": "fed-user",
+                "aud": "minio-tpu-app",
+                "policy": "oidc-rw",
+            }
+        ),
+    )
+    assert r.status == 200, (r.status, r.body[:400])
+    assert f"<{action}Response".encode() in r.body
+    if action == "AssumeRoleWithWebIdentity":
+        assert b"<SubjectFromWebIdentityToken>fed-user<" in r.body
+    ak, sk, st = _creds_from(r.body)
+
+    fed = S3Client(server.endpoint, access_key=ak, secret_key=sk)
+    hdr = {"x-amz-security-token": st}
+    assert fed.put_object(
+        "fedbkt", "hello.txt", b"federated!", headers=hdr
+    ).status == 200
+    assert fed.get_object(
+        "fedbkt", "hello.txt", headers=hdr
+    ).body == b"federated!"
+    assert fed.request(
+        "DELETE", "/fedbkt/hello.txt", headers=hdr
+    ).status == 204
+    # the policy does NOT cover other buckets
+    assert fed.put_object(
+        "otherbkt", "nope", b"x", headers=hdr
+    ).status == 403
+
+
+def test_oidc_sts_rejects_bad_tokens(server, idp):
+    r = _sts_oidc(
+        server, "AssumeRoleWithWebIdentity", "WebIdentityToken",
+        idp.token({"aud": "minio-tpu-app", "policy": "p"}, corrupt=True),
+    )
+    assert r.status == 403 and b"AccessDenied" in r.body
+    # unknown policy name in the claim
+    r = _sts_oidc(
+        server, "AssumeRoleWithWebIdentity", "WebIdentityToken",
+        idp.token(
+            {"aud": "minio-tpu-app", "policy": "no-such-policy"}
+        ),
+    )
+    assert r.status == 403, (r.status, r.body[:300])
+    # no token at all
+    r = _sts_oidc(
+        server, "AssumeRoleWithWebIdentity", "WebIdentityToken", ""
+    )
+    assert r.status == 400
+
+
+def test_oidc_unconfigured_is_clean_error(tmp_path, monkeypatch):
+    monkeypatch.delenv(openid.ENV_CONFIG_URL, raising=False)
+    openid.reset_validator_cache()
+    disks = [XLStorage(str(tmp_path / f"u{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    try:
+        r = _sts_oidc(
+            srv, "AssumeRoleWithWebIdentity", "WebIdentityToken",
+            "x.y.z",
+        )
+        assert r.status == 501, (r.status, r.body[:200])
+    finally:
+        srv.shutdown()

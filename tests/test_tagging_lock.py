@@ -247,7 +247,7 @@ def test_lock_default_retention_stamped(client):
     assert r.status == 200 and b"GOVERNANCE" in r.body
     # deleting the version without bypass is refused
     r = client.delete_object_version("locked2", "obj", vid)
-    assert r.status == 400 and r.error_code == "ObjectLocked"
+    assert r.status == 400 and r.error_code == "InvalidRequest"  # ObjectLocked condition
     # governance bypass succeeds (root holds all permissions)
     r = client.request(
         "DELETE", "/locked2/obj", query={"versionId": vid},
@@ -268,12 +268,12 @@ def test_compliance_cannot_be_bypassed(client):
     assert r.status == 200
     vid = r.headers["x-amz-version-id"]
     r = client.delete_object_version("locked3", "obj", vid)
-    assert r.status == 400 and r.error_code == "ObjectLocked"
+    assert r.status == 400 and r.error_code == "InvalidRequest"  # ObjectLocked condition
     r = client.request(
         "DELETE", "/locked3/obj", query={"versionId": vid},
         headers={"x-amz-bypass-governance-retention": "true"},
     )
-    assert r.status == 400 and r.error_code == "ObjectLocked"
+    assert r.status == 400 and r.error_code == "InvalidRequest"  # ObjectLocked condition
     # weakening compliance retention is refused
     weaker = (
         b"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>"
@@ -283,7 +283,7 @@ def test_compliance_cannot_be_bypassed(client):
     r = client.request(
         "PUT", "/locked3/obj", query={"retention": ""}, body=weaker
     )
-    assert r.status == 400 and r.error_code == "ObjectLocked"
+    assert r.status == 400 and r.error_code == "InvalidRequest"  # ObjectLocked condition
     # an unqualified DELETE still writes a delete marker (AWS allows)
     r = client.delete_object("locked3", "obj")
     assert r.status == 204
@@ -305,7 +305,7 @@ def test_legal_hold_blocks_delete(client):
         "DELETE", "/locked4/obj", query={"versionId": vid},
         headers={"x-amz-bypass-governance-retention": "true"},
     )
-    assert r.status == 400 and r.error_code == "ObjectLocked"
+    assert r.status == 400 and r.error_code == "InvalidRequest"  # ObjectLocked condition
     # releasing the hold unlocks it
     r = client.request(
         "PUT", "/locked4/obj", query={"legal-hold": ""},
@@ -326,7 +326,7 @@ def test_lock_headers_on_unlocked_bucket_rejected(client):
         },
     )
     assert r.status == 400
-    assert r.error_code == "InvalidBucketObjectLockConfiguration"
+    assert r.error_code == "InvalidRequest"  # ObjectLockConfiguration missing
     # mode without date: invalid header pair
     r = client.put_object(
         "nolock", "obj", b"x",
@@ -340,7 +340,7 @@ def test_retention_on_unlocked_bucket(client):
     client.put_object("nolock2", "obj", b"x")
     r = client.request("GET", "/nolock2/obj", query={"retention": ""})
     assert r.status == 400
-    assert r.error_code == "InvalidBucketObjectLockConfiguration"
+    assert r.error_code == "InvalidRequest"  # ObjectLockConfiguration missing
 
 
 def test_multi_delete_respects_worm(client):
@@ -362,7 +362,7 @@ def test_multi_delete_respects_worm(client):
         "POST", "/locked5", query={"delete": ""}, body=body
     )
     assert r.status == 200
-    assert "ObjectLocked" in r.body.decode()
+    assert "WORM" in r.body.decode()
 
 
 def test_multipart_upload_respects_lock_defaults(client):
@@ -401,7 +401,7 @@ def test_multipart_upload_respects_lock_defaults(client):
         "DELETE", "/locked6/big", query={"versionId": vid},
         headers={"x-amz-bypass-governance-retention": "true"},
     )
-    assert r.status == 400 and r.error_code == "ObjectLocked"
+    assert r.status == 400 and r.error_code == "InvalidRequest"  # ObjectLocked condition
 
 
 def test_versioning_suspension_blocked_on_lock_bucket(client):
@@ -446,7 +446,7 @@ def test_governance_upgrade_to_compliance_allowed(client):
         "PUT", "/locked8/obj", query={"retention": ""}, body=weaker,
         headers={"x-amz-bypass-governance-retention": "true"},
     )
-    assert r.status == 400 and r.error_code == "ObjectLocked"
+    assert r.status == 400 and r.error_code == "InvalidRequest"  # ObjectLocked condition
 
 
 # -- SSE config routes ----------------------------------------------------
