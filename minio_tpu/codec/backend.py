@@ -59,6 +59,22 @@ class CodecBackend:
         """(B, n, L) u8 + (B, n, 8) digests -> (B, n) bool intact mask."""
         return (self.digest(shards) == np.asarray(digests)).all(axis=-1)
 
+    # -- async pipeline seam (erasure-encode.go:73-109 overlap) --------
+    #
+    # encode_begin enqueues the H2D transfer + device pass and returns
+    # an opaque handle WITHOUT synchronizing; encode_end materializes
+    # the results.  The streaming encoder keeps exactly one batch in
+    # flight so the device works on block-batch k while the host does
+    # disk/network I/O for batch k-1 (double buffering).  Host-only
+    # backends fall back to eager evaluation - the handle IS the
+    # result, and end() is free.
+
+    def encode_begin(self, data: np.ndarray, parity_shards: int):
+        return self.encode(data, parity_shards)
+
+    def encode_end(self, handle):
+        return handle
+
 
 class TpuBackend(CodecBackend):
     """Device backend: single-chip fused passes, mesh-parallel when the
@@ -94,6 +110,12 @@ class TpuBackend(CodecBackend):
         return m
 
     def encode(self, data, parity_shards):
+        return self.encode_end(self.encode_begin(data, parity_shards))
+
+    def encode_begin(self, data, parity_shards):
+        """Asynchronous start: JAX dispatch is async, so the returned
+        device arrays are futures - the H2D copy and the fused pass
+        run while the caller streams the PREVIOUS batch to disk."""
         import jax.numpy as jnp
 
         from ..ops import codec_step
@@ -102,16 +124,33 @@ class TpuBackend(CodecBackend):
         B, k, L = data.shape
         mesh = self._mesh_for(B, k)
         if mesh is not None:
+            # the mesh path synchronizes internally; eager result
             from ..parallel import mesh as pm
 
             parity_w, digests = pm.mesh_encode_hash(
-                mesh, codec_step.host_bytes_to_words(data), parity_shards, L
+                mesh, codec_step.host_bytes_to_words(data),
+                parity_shards, L,
             )
-            return codec_step.host_words_to_bytes(parity_w), digests
+            return (
+                codec_step.host_words_to_bytes(parity_w), digests,
+            )
         words = jnp.asarray(codec_step.host_bytes_to_words(data))
         parity_w, digests = codec_step.encode_and_hash_words(
             words, parity_shards, L
         )
+        return ("async", parity_w, digests)
+
+    def encode_end(self, handle):
+        if not (
+            isinstance(handle, tuple)
+            and len(handle) == 3
+            and isinstance(handle[0], str)
+            and handle[0] == "async"
+        ):
+            return handle
+        from ..ops import codec_step
+
+        _tag, parity_w, digests = handle
         parity = codec_step.host_words_to_bytes(np.asarray(parity_w))
         return parity, np.asarray(digests)
 

@@ -78,17 +78,38 @@ class BatchingBackend(CodecBackend):
         return job.result
 
     def encode(self, data, parity_shards):
+        return self.encode_end(self.encode_begin(data, parity_shards))
+
+    def encode_begin(self, data, parity_shards):
+        """Non-blocking submit: the job coalesces and runs on the
+        dispatcher while the caller flushes its PREVIOUS batch; the
+        handle resolves in encode_end (double-buffered PUT pipeline).
+
+        The handle counts toward _active until encode_end so that
+        concurrent pipelined streams still coalesce; encode_end's
+        decrement NOTIFIES the dispatcher, which then flushes as soon
+        as every remaining active client has submitted instead of
+        sleeping out the coalesce deadline."""
         data = np.ascontiguousarray(data, dtype=np.uint8)
         B, k, L = data.shape
+        job = _Job("encode", (k, L, parity_shards), (data,))
         with self._cv:
             self._active += 1
+            self._jobs.append(job)
+            self._cv.notify_all()
+        return job
+
+    def encode_end(self, handle):
+        job = handle
         try:
-            return self._submit(
-                "encode", (k, L, parity_shards), (data,)
-            )
+            job.done.wait()
+            if job.error is not None:
+                raise job.error
+            return job.result
         finally:
             with self._cv:
                 self._active -= 1
+                self._cv.notify_all()
 
     def digest(self, shards):
         shards = np.ascontiguousarray(shards, dtype=np.uint8)

@@ -144,25 +144,54 @@ class Erasure:
         k, m = self.data_blocks, self.parity_blocks
         total = 0
         eof = False
-        while not eof:
-            blocks: list[bytes] = []
-            while len(blocks) < batch_blocks and not eof:
-                buf = _read_full(reader, self.block_size)
-                if not buf:
-                    eof = True
+        # double-buffered pipeline (erasure-encode.go:73-109 overlap,
+        # SURVEY stage 8): batch k's H2D + device pass is in flight
+        # while batch k-1's shards stream to disk/network; exactly one
+        # batch pending bounds memory at 2 batches
+        pending = None
+        try:
+            while not eof:
+                blocks: list[bytes] = []
+                while len(blocks) < batch_blocks and not eof:
+                    buf = _read_full(reader, self.block_size)
+                    if not buf:
+                        eof = True
+                        break
+                    if len(buf) < self.block_size:
+                        eof = True
+                    blocks.append(buf)
+                    total += len(buf)
+                if not blocks:
                     break
-                if len(buf) < self.block_size:
-                    eof = True
-                blocks.append(buf)
-                total += len(buf)
-            if not blocks:
-                break
-            self._encode_batch(be, blocks, writers, write_quorum)
-        return total
+                started = self._encode_begin_batch(be, blocks)
+                if pending is not None:
+                    try:
+                        self._flush_batch(
+                            be, pending, writers, write_quorum
+                        )
+                    finally:
+                        pending = started
+                else:
+                    pending = started
+            if pending is not None:
+                p, pending = pending, None
+                self._flush_batch(be, p, writers, write_quorum)
+            return total
+        finally:
+            # an error mid-flush must not abandon begun handles: a
+            # batching backend counts them active until ended, so a
+            # leak would degrade every later codec call
+            for handle, _batch in pending or []:
+                try:
+                    be.encode_end(handle)
+                except Exception:  # noqa: BLE001
+                    pass
 
-    def _encode_batch(self, be, blocks, writers, write_quorum) -> None:
-        k, m = self.data_blocks, self.parity_blocks
-        n = k + m
+    def _encode_begin_batch(self, be, blocks):
+        """Kick off the device passes for one batch of blocks; returns
+        [(handle, batch_array), ...] per uniform-shard-size group."""
+        k = self.data_blocks
+        m = self.parity_blocks
         # uniform batch: all blocks but possibly the last share shard size
         groups: list[tuple[int, list[bytes]]] = []
         full = [b for b in blocks if len(b) == self.block_size]
@@ -171,6 +200,7 @@ class Erasure:
             groups.append((self.shard_size_padded(), full))
         for b in tail:
             groups.append((self.shard_size_padded(len(b)), [b]))
+        started = []
         for shard_len, group in groups:
             batch = np.zeros((len(group), k, shard_len), dtype=np.uint8)
             for bi, block in enumerate(group):
@@ -181,8 +211,35 @@ class Erasure:
                         batch[bi, s, : len(chunk)] = np.frombuffer(
                             chunk, dtype=np.uint8
                         )
-            parity, digests = be.encode(batch, m)
-            for bi in range(len(group)):
+            started.append((be.encode_begin(batch, m), batch))
+        return started
+
+    def _flush_batch(self, be, started, writers, write_quorum) -> None:
+        k, m = self.data_blocks, self.parity_blocks
+        n = k + m
+        try:
+            self._flush_groups(
+                be, started, writers, write_quorum, k, n
+            )
+        except BaseException:
+            # end the groups the failed iteration never reached
+            # (batching backends count begun handles as active)
+            for item in started:
+                if item is None:
+                    continue  # already consumed by encode_end
+                try:
+                    be.encode_end(item[0])
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+
+    def _flush_groups(
+        self, be, started, writers, write_quorum, k, n
+    ) -> None:
+        for i, (handle, batch) in enumerate(started):
+            started[i] = None  # consumed: error path must not re-end
+            parity, digests = be.encode_end(handle)
+            for bi in range(batch.shape[0]):
                 alive = 0
                 for s in range(n):
                     w = writers[s] if s < len(writers) else None
