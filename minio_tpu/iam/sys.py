@@ -396,6 +396,17 @@ class IAMSys:
             doc = dict(u)
         self._save_doc("users", access_key, doc)
 
+    def set_user_secret(self, access_key: str, secret_key: str) -> None:
+        """Rotate a user's secret key in place (the console SetAuth
+        path, web-handlers.go:850); policy/status are untouched."""
+        with self._mu:
+            u = self._users.get(access_key)
+            if u is None:
+                raise UserNotFound(access_key)
+            u["secret"] = secret_key
+            doc = dict(u)
+        self._save_doc("users", access_key, doc)
+
     def set_user_policy(self, access_key: str, policy: str) -> None:
         if policy:
             self.get_policy(policy)

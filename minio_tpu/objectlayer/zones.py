@@ -100,12 +100,34 @@ class ErasureZones(ObjectLayer):
         key, so placement is reproducible and testable."""
         if len(self.zones) == 1:
             return 0
-        for i, z in enumerate(self.zones):
+        # probe every zone CONCURRENTLY: the existence check is on
+        # the write path, so its wall cost must be one zone's RTT,
+        # not the sum (r4 review: the serial walk was O(zones)
+        # remote calls per new-object PUT)
+        hits = [False] * len(self.zones)
+
+        def probe(i, z):
             try:
                 z.get_object_info(bucket, object_name)
-                return i
+                hits[i] = True
             except Exception:  # noqa: BLE001
-                continue
+                pass
+
+        threads = [
+            threading.Thread(
+                target=probe, args=(i, z), daemon=True
+            )
+            for i, z in enumerate(self.zones)
+        ]
+        for t in threads:
+            t.start()
+        # join in index order and return at the first hit: an early
+        # zone that owns the object answers without waiting for a
+        # slow/hung later zone (the serial walk's fast path, kept)
+        for i, t in enumerate(threads):
+            t.join()
+            if hits[i]:  # lowest index wins, like the serial walk
+                return i
         avail = self._available_space(size)
         total = sum(avail)
         if total <= 0:

@@ -34,7 +34,7 @@ class SelectRequest:
         self.expression = ""
         self.expression_type = "SQL"
         self.compression = "NONE"
-        self.input_format = ""  # CSV | JSON
+        self.input_format = ""  # CSV | JSON | PARQUET
         self.csv_args = csvio.CSVArgs()
         self.json_args = jsonio.JSONArgs()
         self.output_format = ""  # CSV | JSON (defaults to input)
@@ -110,9 +110,14 @@ class SelectRequest:
                 raise SelectError("InvalidJsonType", f"bad Type {jt}")
             req.json_args = jsonio.JSONArgs(jt)
         elif child(inser, "Parquet") is not None:
-            raise SelectError(
-                "InvalidDataSource", "Parquet input is not supported"
-            )
+            req.input_format = "PARQUET"
+            if req.compression != "NONE":
+                # parquet compression lives inside the pages, not
+                # around the stream (select.go parquet branch)
+                raise SelectError(
+                    "InvalidRequestParameter",
+                    "CompressionType must be NONE for Parquet",
+                )
         else:
             raise SelectError(
                 "InvalidDataSource", "CSV or JSON input required"
@@ -145,7 +150,12 @@ class SelectRequest:
                     or "\n",
                 }
         if not req.output_format:
-            req.output_format = req.input_format
+            # parquet is input-only; its records default to JSON out
+            req.output_format = (
+                "JSON"
+                if req.input_format == "PARQUET"
+                else req.input_format
+            )
         prog = child(root, "RequestProgress")
         if prog is not None:
             req.progress = (
@@ -174,6 +184,10 @@ class S3Select:
     def _records(self, stream):
         if self.req.input_format == "CSV":
             return csvio.read_records(stream, self.req.csv_args)
+        if self.req.input_format == "PARQUET":
+            from . import parquetio
+
+            return parquetio.read_records(stream)
         return jsonio.read_records(stream, self.req.json_args)
 
     def _writer(self):
@@ -192,11 +206,14 @@ class S3Select:
         # SELECT * rows carry reader-internal aliases (_N shadows of
         # named CSV columns, dotted JSON child paths) that projected
         # records never have - clean them per input format
-        clean = (
-            csvio.clean_raw_row
-            if self.req.input_format == "CSV"
-            else jsonio.clean_raw_row
-        )
+        if self.req.input_format == "CSV":
+            clean = csvio.clean_raw_row
+        elif self.req.input_format == "PARQUET":
+            from . import parquetio
+
+            clean = parquetio.clean_raw_row
+        else:
+            clean = jsonio.clean_raw_row
 
         def flush():
             nonlocal returned

@@ -249,6 +249,92 @@ def _set_bucket_policy(h, params, access_key) -> dict:
     return {}
 
 
+def _generate_auth(h, params, access_key) -> dict:
+    """Fresh random credential pair for the console's 'generate'
+    button (web-handlers.go:823 GenerateAuth); owner only, nothing is
+    persisted until SetAuth/add-user applies it."""
+    if not h.s3.iam.is_owner(access_key):
+        raise WebError("only the owner can generate credentials")
+    from ..iam.sys import generate_credentials
+
+    ak, sk = generate_credentials()
+    return {"accessKey": ak, "secretKey": sk}
+
+
+def _set_auth(h, params, access_key) -> dict:
+    """Change the calling IAM user's OWN secret key after proving the
+    current one (web-handlers.go:850 SetAuth); the owner's root
+    credential cannot be changed through the browser."""
+    import hmac as hmac_mod
+
+    if h.s3.iam.is_owner(access_key):
+        raise WebError(
+            "owner credentials cannot be changed via the console"
+        )
+    current = params.get("currentSecretKey", "")
+    new = params.get("newSecretKey", "")
+    secret = h.s3.iam.lookup_secret(access_key)
+    if secret is None or not hmac_mod.compare_digest(
+        secret, current
+    ):
+        raise WebError("current secret key does not match")
+    if len(new) < 8:
+        raise WebError("new secret key must be at least 8 characters")
+    h.s3.iam.set_user_secret(access_key, new)
+    return {}
+
+
+def _list_all_bucket_policies(h, params, access_key) -> dict:
+    """Per-prefix canned access summary of the bucket policy
+    (web-handlers.go:1721 ListAllBucketPolicies): for each resource
+    prefix the policy names, report readonly/writeonly/readwrite as
+    the anonymous GET/PUT decisions the engine would actually make."""
+    from ..iam.policy import Args, Policy
+
+    bucket = params.get("bucketName", "")
+    _allow(h, access_key, "s3:GetBucketPolicy", bucket)
+    h.s3.object_layer.get_bucket_info(bucket)
+    raw = h.s3.bucket_meta.get(bucket).policy_json or ""
+    if not raw:
+        return {"policies": []}
+    try:
+        pol = Policy.from_json(raw)
+    except Exception as e:  # noqa: BLE001
+        raise WebError(f"bad stored policy: {e}") from None
+    prefixes: "set[str]" = set()
+    for st in getattr(pol, "statements", []):
+        for res in getattr(st, "resources", []):
+            tail = res.split(":::", 1)[-1]
+            if tail.startswith(bucket):
+                rest = tail[len(bucket):].lstrip("/")
+                prefixes.add(rest.rstrip("*"))
+    out = []
+    for prefix in sorted(prefixes):
+        probe = prefix + "obj"
+        can_read = pol.is_allowed(
+            Args(
+                account="", action="s3:GetObject",
+                bucket=bucket, object=probe,
+            )
+        )
+        can_write = pol.is_allowed(
+            Args(
+                account="", action="s3:PutObject",
+                bucket=bucket, object=probe,
+            )
+        )
+        level = {
+            (True, True): "readwrite",
+            (True, False): "readonly",
+            (False, True): "writeonly",
+            (False, False): "none",
+        }[(can_read, can_write)]
+        out.append(
+            {"bucket": bucket, "prefix": prefix, "policy": level}
+        )
+    return {"policies": out}
+
+
 _METHODS = {
     "web.ServerInfo": _server_info,
     "web.StorageInfo": _storage_info,
@@ -259,8 +345,11 @@ _METHODS = {
     "web.RemoveObject": _remove_objects,
     "web.GetBucketPolicy": _get_bucket_policy,
     "web.SetBucketPolicy": _set_bucket_policy,
+    "web.ListAllBucketPolicies": _list_all_bucket_policies,
     "web.PresignedGet": _presigned_get,
     "web.CreateURLToken": _create_url_token,
+    "web.GenerateAuth": _generate_auth,
+    "web.SetAuth": _set_auth,
 }
 
 

@@ -191,6 +191,24 @@ class StorageRESTClient(StorageAPI):
         self._online = True
         self._last_probe = 0.0
         self._disk_id = ""
+        # storage-op deadlines self-tune from observed durations, the
+        # same adaptation the namespace locks use (dynamic-timeouts.go
+        # applied to storage RPCs, not just locking).  One budget PER
+        # OPERATION CLASS: cheap metadata ops must not shrink the
+        # deadline under a large shard stream (the reference keeps
+        # separate dynamic timeouts for the same reason)
+        from ..utils.dyntimeout import DynamicTimeout
+
+        self._dyn_meta = DynamicTimeout(timeout, max(1.0, timeout / 10))
+        self._dyn_bulk = DynamicTimeout(timeout, max(5.0, timeout / 4))
+
+    # data-bearing RPCs whose duration scales with payload/namespace
+    _BULK_METHODS = frozenset(
+        {
+            "createfile", "appendfile", "readfilestream", "readall",
+            "writeall", "walk", "listdir", "deletevol", "renamefile",
+        }
+    )
 
     # ---- transport ------------------------------------------------------
 
@@ -235,13 +253,33 @@ class StorageRESTClient(StorageAPI):
             "Authorization": f"Bearer {self._bearer()}",
             "Content-Length": str(len(body)),
         }
+        dyn = (
+            self._dyn_bulk
+            if method in self._BULK_METHODS
+            else self._dyn_meta
+        )
+        op_deadline = dyn.timeout
+        t0 = time.monotonic()
         for attempt in (0, 1):
             conn = self._conn()
+            conn.timeout = op_deadline
+            if getattr(conn, "sock", None) is not None:
+                conn.sock.settimeout(op_deadline)
             try:
                 conn.request("POST", url, body=body or None, headers=headers)
                 resp = conn.getresponse()
                 payload = resp.read()
                 break
+            except TimeoutError:
+                # the adaptive deadline fired: grow the budget
+                dyn.log_failure()
+                self._drop_conn()
+                if attempt:
+                    self._online = False
+                    self._last_probe = time.time()
+                    raise DiskNotFound(
+                        f"{self._endpoint} timed out"
+                    ) from None
             except (OSError, http.client.HTTPException):
                 # one retry on a fresh connection (stale keep-alive)
                 self._drop_conn()
@@ -251,6 +289,7 @@ class StorageRESTClient(StorageAPI):
                     raise DiskNotFound(
                         f"{self._endpoint} unreachable"
                     ) from None
+        dyn.log_success(time.monotonic() - t0)
         self._online = True
         if resp.status == 200:
             return payload

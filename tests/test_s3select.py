@@ -293,10 +293,19 @@ def test_request_validation():
     with pytest.raises(SelectError) as ei:
         SelectRequest.from_xml(b"")
     assert ei.value.code == "EmptyRequestBody"
-    bad = (
+    ok = (
         b"<SelectObjectContentRequest>"
         b"<Expression>SELECT * FROM S3Object</Expression>"
         b"<InputSerialization><Parquet/></InputSerialization>"
+        b"</SelectObjectContentRequest>"
+    )
+    req = SelectRequest.from_xml(ok)
+    assert req.input_format == "PARQUET"
+    assert req.output_format == "JSON"  # parquet is input-only
+    bad = (
+        b"<SelectObjectContentRequest>"
+        b"<Expression>SELECT * FROM S3Object</Expression>"
+        b"<InputSerialization><Avro/></InputSerialization>"
         b"</SelectObjectContentRequest>"
     )
     with pytest.raises(SelectError) as ei:

@@ -476,3 +476,81 @@ def test_web_download_zip(server):
         {"Content-Type": "application/json"},
     )
     assert st == 403
+
+
+def test_generate_and_set_auth(server):
+    """GenerateAuth (owner-only) + SetAuth rotating an IAM user's own
+    secret (web-handlers.go:823,850)."""
+    root = _login(server)
+    gen = _rpc(server, "web.GenerateAuth", {}, root)["result"]
+    assert len(gen["accessKey"]) >= 3 and len(gen["secretKey"]) >= 8
+    # non-owner cannot generate
+    server.iam.add_user("authu", "firstsecret99", "readwrite")
+    utok = _rpc(
+        server, "web.Login",
+        {"username": "authu", "password": "firstsecret99"},
+    )["result"]["token"]
+    assert "error" in _rpc(server, "web.GenerateAuth", {}, utok)
+    # owner cannot SetAuth
+    assert "error" in _rpc(
+        server, "web.SetAuth",
+        {"currentSecretKey": "minioadmin",
+         "newSecretKey": "newrootpw999"},
+        root,
+    )
+    # wrong current secret refused
+    assert "error" in _rpc(
+        server, "web.SetAuth",
+        {"currentSecretKey": "wrong", "newSecretKey": "nextsecret99"},
+        utok,
+    )
+    # correct rotation: old secret dies, new one logs in
+    assert "result" in _rpc(
+        server, "web.SetAuth",
+        {"currentSecretKey": "firstsecret99",
+         "newSecretKey": "nextsecret99"},
+        utok,
+    )
+    assert "error" in _rpc(
+        server, "web.Login",
+        {"username": "authu", "password": "firstsecret99"},
+    )
+    assert "result" in _rpc(
+        server, "web.Login",
+        {"username": "authu", "password": "nextsecret99"},
+    )
+
+
+def test_list_all_bucket_policies(server):
+    token = _login(server)
+    _rpc(server, "web.MakeBucket", {"bucketName": "polsum"}, token)
+    policy = json.dumps(
+        {
+            "Version": "2012-10-17",
+            "Statement": [
+                {
+                    "Effect": "Allow",
+                    "Principal": "*",
+                    "Action": ["s3:GetObject"],
+                    "Resource": "arn:aws:s3:::polsum/public/*",
+                },
+                {
+                    "Effect": "Allow",
+                    "Principal": "*",
+                    "Action": ["s3:GetObject", "s3:PutObject"],
+                    "Resource": "arn:aws:s3:::polsum/drop/*",
+                },
+            ],
+        }
+    )
+    assert "result" in _rpc(
+        server, "web.SetBucketPolicy",
+        {"bucketName": "polsum", "policy": policy}, token,
+    )
+    pols = _rpc(
+        server, "web.ListAllBucketPolicies",
+        {"bucketName": "polsum"}, token,
+    )["result"]["policies"]
+    by_prefix = {p["prefix"]: p["policy"] for p in pols}
+    assert by_prefix.get("public/") == "readonly"
+    assert by_prefix.get("drop/") == "readwrite"
