@@ -286,33 +286,107 @@ class Erasure:
         k = self.data_blocks
         start_block = offset // self.block_size
         end_block = (offset + length - 1) // self.block_size
-        written = 0
-        heal_required = False
+        batches: "list[list[int]]" = []
         bi = start_block
         while bi <= end_block:
-            batch_idx = list(range(bi, min(bi + batch_blocks, end_block + 1)))
-            # group by shard size (tail block may differ)
-            datas, healed = self._decode_blocks(
-                be, readers, batch_idx, total_length
+            batch_idx = list(
+                range(bi, min(bi + batch_blocks, end_block + 1))
             )
-            heal_required = heal_required or healed
-            for j, block_index in enumerate(batch_idx):
-                block_start = block_index * self.block_size
-                block_len = self._block_len(block_index, total_length)
-                lo = max(offset, block_start) - block_start
-                hi = min(offset + length, block_start + block_len) - block_start
-                if hi > lo:
-                    try:
-                        writer.write(datas[j][lo:hi])
-                    except compress.RangeSatisfied:
-                        # a skipping decompressor downstream has its
-                        # full range: stop paying decode I/O, but keep
-                        # the heal verdict observed so far (losing it
-                        # here would mask bitrot on range reads)
-                        return written, heal_required
-                    written += hi - lo
+            batches.append(batch_idx)
             bi += len(batch_idx)
-        return written, heal_required
+        written = 0
+        heal_required = False
+        # the read-ahead thread earns its keep when shard reads block
+        # on the network (GIL released, batch k+1's RTTs overlap the
+        # client write of batch k); for all-local page-cache reads on
+        # a busy host it only adds scheduler contention
+        remote = any(
+            r is not None and not getattr(r, "is_local", True)
+            for r in readers
+        )
+        if len(batches) <= 1 or not remote:
+            for batch_idx in batches:
+                datas, healed = self._decode_blocks(
+                    be, readers, batch_idx, total_length
+                )
+                heal_required = heal_required or healed
+                w, done = self._write_blocks(
+                    writer, datas, batch_idx, offset, length,
+                    total_length,
+                )
+                written += w
+                if done:
+                    return written, heal_required
+            return written, heal_required
+        # read-ahead pipeline (the GET twin of the encode double
+        # buffer): batch k+1's shard reads + verify + reconstruct run
+        # on a worker thread while batch k streams to the client.
+        # Exactly one prefetch is in flight, so _decode_blocks never
+        # runs concurrently with itself (it mutates `readers`).
+        import concurrent.futures
+
+        pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="decode-readahead"
+        )
+        fut = None
+        try:
+            fut = pool.submit(
+                self._decode_blocks, be, readers, batches[0],
+                total_length,
+            )
+            for i, batch_idx in enumerate(batches):
+                datas, healed = fut.result()
+                fut = None
+                heal_required = heal_required or healed
+                if i + 1 < len(batches):
+                    fut = pool.submit(
+                        self._decode_blocks, be, readers,
+                        batches[i + 1], total_length,
+                    )
+                w, done = self._write_blocks(
+                    writer, datas, batch_idx, offset, length,
+                    total_length,
+                )
+                written += w
+                if done:
+                    return written, heal_required
+            return written, heal_required
+        finally:
+            # an early return (RangeSatisfied, client gone) must not
+            # leave the prefetch racing the caller's reader close -
+            # drain the in-flight read before handing back
+            if fut is not None:
+                fut.cancel()
+                try:
+                    fut.result()
+                except Exception:  # noqa: BLE001
+                    pass
+            pool.shutdown(wait=True)
+
+    def _write_blocks(
+        self, writer, datas, batch_idx, offset, length, total_length
+    ) -> "tuple[int, bool]":
+        """Stream one decoded batch's range slices; (written, done)
+        where done means a skipping decompressor downstream has its
+        full range (RangeSatisfied - stop paying decode I/O, but keep
+        the heal verdict observed so far: losing it would mask bitrot
+        on range reads)."""
+        written = 0
+        for j, block_index in enumerate(batch_idx):
+            block_start = block_index * self.block_size
+            block_len = self._block_len(block_index, total_length)
+            lo = max(offset, block_start) - block_start
+            hi = (
+                min(offset + length, block_start + block_len)
+                - block_start
+            )
+            if hi > lo:
+                try:
+                    writer.write(datas[j][lo:hi])
+                except compress.RangeSatisfied:
+                    return written, True
+                written += hi - lo
+        return written, False
 
     def _decode_blocks(
         self, be, readers, block_indices: list[int], total_length: int

@@ -313,3 +313,63 @@ def test_encode_pipeline_overlaps_batches():
     out = io.BytesIO()
     er.decode(out, readers, 0, len(payload), len(payload))
     assert out.getvalue() == payload
+
+
+def test_decode_readahead_overlaps_remote_reads():
+    """GET twin of the encode pipeline: with remote readers, batch
+    k+1's shard reads begin WHILE batch k is still streaming to the
+    client - the writer blocks until it observes a later-batch read,
+    so a silently-sequential decode fails this test by timeout."""
+    import threading as _threading
+
+    k, m, bs = 2, 2, 1024
+    er = Erasure(k, m, bs)
+    payload = bytes(range(256)) * 16  # 4 blocks
+    shards = [MemShard() for _ in range(k + m)]
+    er.encode(io.BytesIO(payload), list(shards), write_quorum=k + 1)
+
+    later_read = _threading.Event()
+    first_batch_off = er.shard_block_offset(0)
+
+    class RemoteShard(MemShard):
+        is_local = False
+
+        def __init__(self, inner):
+            self.buf = inner.buf
+
+        def read_at(self, off, ln):
+            if off > first_batch_off:
+                later_read.set()
+            return super().read_at(off, ln)
+
+    overlap_seen = []
+
+    class BlockingWriter:
+        """First write waits for proof a later batch is being read."""
+
+        def __init__(self):
+            self.calls = 0
+
+        def write(self, b):
+            self.calls += 1
+            if self.calls == 1:
+                overlap_seen.append(later_read.wait(timeout=10))
+
+    readers = [RemoteShard(s) for s in shards]
+    written, heal = er.decode(
+        BlockingWriter(), list(readers), 0, len(payload),
+        len(payload), batch_blocks=1,
+    )
+    assert written == len(payload) and not heal
+    assert overlap_seen == [True], (
+        "no later-batch read observed while the first batch was "
+        "still being written: the read-ahead pipeline is not running"
+    )
+    # and the bytes are right through the same path
+    buf = io.BytesIO()
+    er.decode(
+        buf,
+        [RemoteShard(s) for s in shards],
+        0, len(payload), len(payload), batch_blocks=1,
+    )
+    assert buf.getvalue() == payload
