@@ -565,15 +565,31 @@ class Erasure:
             shards = np.zeros((1, n, shard_len), dtype=np.uint8)
             digests = np.zeros((1, n, 8), dtype=np.uint32)
             present = np.zeros(n, dtype=bool)
-            for s in range(n):
-                r = readers[s] if s < len(readers) else None
-                if r is None:
-                    continue
+
+            def read_frame(s):
                 try:
-                    buf = r.read_at(off, frame)
-                except OSError:
-                    continue
-                if len(buf) != frame:
+                    buf = readers[s].read_at(off, frame)
+                except Exception:  # noqa: BLE001 - dead shard (the
+                    # remote plane raises StorageError, not OSError)
+                    return None
+                return buf if len(buf) == frame else None
+
+            live = [
+                s
+                for s in range(n)
+                if s < len(readers) and readers[s] is not None
+            ]
+            if len(live) > 1 and any(
+                not getattr(readers[s], "is_local", True)
+                for s in live
+            ):
+                # survivors on remote disks: one RTT, not a serial
+                # walk (the heal twin of the decode fan-out)
+                results = _parallel_map(read_frame, live)
+            else:
+                results = [read_frame(s) for s in live]
+            for s, buf in zip(live, results):
+                if buf is None:
                     continue
                 digests[0, s] = bitrot.digest_from_bytes(
                     buf[: bitrot.DIGEST_SIZE]
