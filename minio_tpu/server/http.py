@@ -540,7 +540,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         cl = self.headers.get("Content-Length")
         if cl and cl not in ("0", ""):
-            self.close_connection = True
+            try:
+                n = int(cl)
+            except ValueError:
+                n = -1
+            if 0 <= n <= (1 << 20):
+                self.rfile.read(n)  # drain small, keep the connection
+            else:
+                self.close_connection = True
 
     def _is_post_policy(self, path: str, query) -> bool:
         return (
@@ -560,6 +567,12 @@ class _Handler(BaseHTTPRequestHandler):
         self._action = ""
         self._last_status = 0
         self._resp_bytes = 0
+        if self.command not in ("GET", "PUT", "POST", "DELETE", "HEAD"):
+            # non-S3 verbs (PATCH, OPTIONS, PROPFIND, ...) answer the
+            # S3 MethodNotAllowed document - with the body drained for
+            # keep-alive hygiene, not the stdlib's bare 501 HTML
+            self._finish_body()
+            return self._error(s3errors.get("MethodNotAllowed"), path)
         for prefix, handler in self.s3.internode.items():
             if path.startswith(prefix + "/"):
                 return self._route_internode(
@@ -857,6 +870,15 @@ class _Handler(BaseHTTPRequestHandler):
             _time.sleep(0.5)
 
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = route
+
+    def __getattr__(self, name):
+        """ANY verb reaches route() (which answers MethodNotAllowed
+        for non-S3 ones with full per-request init and body drain);
+        without this, unknown verbs fall through to the stdlib's bare
+        501 HTML."""
+        if name.startswith("do_"):
+            return self.route
+        raise AttributeError(name)
 
     # -- authorization (checkRequestAuthType, auth-handler.go:272) --------
 
