@@ -374,6 +374,12 @@ def bench_select_scan() -> dict:
     }
 
 
+def _kernel_stats_snapshot():
+    from minio_tpu.codec.telemetry import KERNEL_STATS
+
+    return KERNEL_STATS.snapshot()
+
+
 def main() -> None:
     import os
 
@@ -459,6 +465,11 @@ def main() -> None:
                         else None
                     ),
                     "select": select_scan,
+                    # kernel-level call/byte/seconds telemetry
+                    # accumulated across the e2e runs above, so the
+                    # bench trajectory records what the codec seam
+                    # actually executed (codec/telemetry.py)
+                    "kernel_stats": _kernel_stats_snapshot(),
                 },
             }
         )

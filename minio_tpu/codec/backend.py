@@ -274,12 +274,16 @@ def get_backend(name: "str | None" = None) -> CodecBackend:
 
 
 def _make(name: str) -> CodecBackend:
+    # kernel telemetry wraps the CONCRETE backend, under the batcher:
+    # a coalesced flush is one recorded call with real device seconds,
+    # while queue wait is the batcher's own series (codec/telemetry.py)
     from .batcher import maybe_wrap
+    from .telemetry import instrument
 
     if name == "cpu":
-        return maybe_wrap(CpuBackend())
+        return maybe_wrap(instrument(CpuBackend()))
     if name == "tpu":
-        return maybe_wrap(TpuBackend())
+        return maybe_wrap(instrument(TpuBackend()))
     if name == "auto":
         try:
             import jax
@@ -287,9 +291,9 @@ def _make(name: str) -> CodecBackend:
             # any jax backend (tpu or the CPU test platform) works; the
             # device path dispatches pallas-vs-portable internally
             jax.devices()
-            return maybe_wrap(TpuBackend())
+            return maybe_wrap(instrument(TpuBackend()))
         except Exception:
-            return maybe_wrap(CpuBackend())
+            return maybe_wrap(instrument(CpuBackend()))
     raise ValueError(f"unknown erasure backend {name!r}")
 
 

@@ -23,14 +23,18 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
 from .backend import CodecBackend
+from .telemetry import KERNEL_STATS
 
 
 class _Job:
-    __slots__ = ("op", "key", "arrays", "result", "error", "done")
+    __slots__ = (
+        "op", "key", "arrays", "result", "error", "done", "created",
+    )
 
     def __init__(self, op: str, key: tuple, arrays: tuple):
         self.op = op
@@ -39,6 +43,7 @@ class _Job:
         self.result = None
         self.error: "BaseException | None" = None
         self.done = threading.Event()
+        self.created = time.monotonic()
 
 
 class BatchingBackend(CodecBackend):
@@ -146,8 +151,6 @@ class BatchingBackend(CodecBackend):
     def _collect(self) -> "list[_Job]":
         """Take a coalescible batch off the queue (holds no deadline
         when every active client has already submitted)."""
-        import time
-
         with self._cv:
             while self._running and not self._jobs:
                 self._cv.wait(0.1)
@@ -178,6 +181,12 @@ class BatchingBackend(CodecBackend):
                 if not self._running:
                     return
                 continue
+            now = time.monotonic()
+            KERNEL_STATS.record_batch_flush(
+                len(jobs),
+                sum(j.arrays[0].shape[0] for j in jobs),
+                sum(now - j.created for j in jobs),
+            )
             groups: dict[tuple, list[_Job]] = {}
             for j in jobs:
                 groups.setdefault((j.op, j.key), []).append(j)

@@ -27,6 +27,7 @@ import threading
 import numpy as np
 
 from . import backend as backend_mod, bitrot, compress
+from .telemetry import KERNEL_STATS
 
 BLOCK_SIZE_V1 = 10 * 1024 * 1024  # reference blockSizeV1
 DEFAULT_BATCH_BLOCKS = 4
@@ -176,6 +177,7 @@ class Erasure:
             if pending is not None:
                 p, pending = pending, None
                 self._flush_batch(be, p, writers, write_quorum)
+            KERNEL_STATS.record_stream("encode", total)
             return total
         finally:
             # an error mid-flush must not abandon begun handles: a
@@ -278,6 +280,25 @@ class Erasure:
         still allowed reconstruction (errHealRequired semantics,
         erasure-decode.go:165-167).
         """
+        written, heal_required = self._decode_stream(
+            writer, readers, offset, length, total_length,
+            batch_blocks, backend,
+        )
+        KERNEL_STATS.record_stream("decode", written)
+        if heal_required:
+            KERNEL_STATS.record_heal_required()
+        return written, heal_required
+
+    def _decode_stream(
+        self,
+        writer,
+        readers: list,
+        offset: int,
+        length: int,
+        total_length: int,
+        batch_blocks: int = DEFAULT_BATCH_BLOCKS,
+        backend: "backend_mod.CodecBackend | None" = None,
+    ) -> tuple[int, bool]:
         if length == 0:
             return 0, False
         if offset < 0 or length < 0 or offset + length > total_length:
@@ -613,6 +634,7 @@ class Erasure:
                     continue
                 frame_bytes = bitrot.digest_to_bytes(new_digests[0, s])
                 w.write(frame_bytes + full[s].tobytes())
+        KERNEL_STATS.record_stream("heal", total_length)
 
 
 def _read_full(reader, size: int) -> bytes:

@@ -1,0 +1,203 @@
+"""Codec kernel telemetry: what the fused device passes actually did.
+
+The paper's thesis lives in the byte-crunching hot paths (RS erasure,
+bitrot hashing) running as batched device passes behind the
+``reedsolomon.Encoder``-shaped seam (backend.py).  This module measures
+those passes in production:
+
+* ``KernelStats`` - process-wide registry of per-op counters: calls,
+  bytes processed, and host-observed device seconds, labeled by the
+  resolved backend (``tpu``/``cpu``); plus batcher occupancy (jobs
+  coalesced per flush, queue wait) and erasure-stream totals.
+* ``InstrumentedBackend`` - a CodecBackend decorator recording every
+  encode / encode_begin-end / digest / reconstruct through the seam.
+  It wraps the CONCRETE backend (below the batching layer), so a
+  coalesced flush counts as one call and its seconds are real device
+  launch time, not queue wait - queue wait is the batcher's own series.
+
+"Device seconds" are host-observed: the time the calling thread spends
+inside the codec call (for the async begin/end pair, dispatch time plus
+materialization time).  On a host-only backend that IS compute time; on
+a device backend it includes H2D/D2H transfers - which is exactly the
+cost an operator provisioning the serving path cares about.
+
+Everything is exported as ``miniotpu_codec_*`` Prometheus families
+(server/metrics.py) and snapshot-dumpable via ``admin kernel-stats``
+(server/admin.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .backend import CodecBackend
+
+
+class KernelStats:
+    """Thread-safe registry of codec hot-path counters."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (op, backend) -> [calls, bytes, seconds]
+        self._ops: "dict[tuple[str, str], list]" = {}
+        # batcher occupancy: flushes, jobs, blocks, queue-wait seconds
+        self._batch = [0, 0, 0, 0.0]
+        # erasure-layer streams: kind -> [streams, bytes]
+        self._streams: "dict[str, list]" = {}
+        self._heal_required = 0
+
+    # -- recording --------------------------------------------------------
+
+    def record_op(
+        self, op: str, backend: str, nbytes: int, seconds: float
+    ) -> None:
+        with self._mu:
+            row = self._ops.setdefault((op, backend), [0, 0, 0.0])
+            row[0] += 1
+            row[1] += nbytes
+            row[2] += seconds
+
+    def record_batch_flush(
+        self, jobs: int, blocks: int, wait_s: float
+    ) -> None:
+        with self._mu:
+            self._batch[0] += 1
+            self._batch[1] += jobs
+            self._batch[2] += blocks
+            self._batch[3] += wait_s
+
+    def record_stream(self, kind: str, nbytes: int) -> None:
+        with self._mu:
+            row = self._streams.setdefault(kind, [0, 0])
+            row[0] += 1
+            row[1] += nbytes
+
+    def record_heal_required(self) -> None:
+        with self._mu:
+            self._heal_required += 1
+
+    # -- reading ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-friendly dump (admin kernel-stats, bench.py trajectory)."""
+        with self._mu:
+            return {
+                "ops": [
+                    {
+                        "op": op,
+                        "backend": be,
+                        "calls": calls,
+                        "bytes": nbytes,
+                        "seconds": round(secs, 6),
+                    }
+                    for (op, be), (calls, nbytes, secs) in sorted(
+                        self._ops.items()
+                    )
+                ],
+                "batch": {
+                    "flushes": self._batch[0],
+                    "jobs": self._batch[1],
+                    "blocks": self._batch[2],
+                    "wait_seconds": round(self._batch[3], 6),
+                },
+                "streams": [
+                    {"kind": kind, "streams": n, "bytes": nbytes}
+                    for kind, (n, nbytes) in sorted(
+                        self._streams.items()
+                    )
+                ],
+                "heal_required": self._heal_required,
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self._ops.clear()
+            self._batch = [0, 0, 0, 0.0]
+            self._streams.clear()
+            self._heal_required = 0
+
+
+# Process-wide singleton: one codec seam per process (backend.py caches
+# one backend), so one registry; tests reset() it.
+KERNEL_STATS = KernelStats()
+
+
+class InstrumentedBackend(CodecBackend):
+    """CodecBackend decorator feeding a KernelStats registry.
+
+    ``name`` mirrors the inner backend so layers keying behavior off it
+    (the batcher's power-of-two padding for ``tpu``) are unaffected.
+    ``verify`` is inherited from CodecBackend on purpose: the default
+    routes through ``self.digest`` and is therefore recorded.
+    """
+
+    def __init__(self, inner: CodecBackend, stats: "KernelStats | None" = None):
+        self.inner = inner
+        self.stats = stats if stats is not None else KERNEL_STATS
+        self.name = getattr(inner, "name", "unknown")
+
+    def _timed(self, op: str, nbytes: int, fn):
+        t0 = time.monotonic()
+        try:
+            return fn()
+        finally:
+            self.stats.record_op(
+                op, self.name, nbytes, time.monotonic() - t0
+            )
+
+    def encode(self, data, parity_shards):
+        return self._timed(
+            "encode",
+            data.nbytes,
+            lambda: self.inner.encode(data, parity_shards),
+        )
+
+    def encode_begin(self, data, parity_shards):
+        # async pair: dispatch time here, materialization time in
+        # encode_end; recorded once, at end, as one encode call
+        t0 = time.monotonic()
+        handle = self.inner.encode_begin(data, parity_shards)
+        return ("ktel", handle, time.monotonic() - t0, data.nbytes)
+
+    def encode_end(self, handle):
+        if not (
+            isinstance(handle, tuple)
+            and len(handle) == 4
+            and handle[0] == "ktel"
+        ):
+            return self.inner.encode_end(handle)
+        _tag, inner_handle, dispatch_s, nbytes = handle
+        t0 = time.monotonic()
+        try:
+            return self.inner.encode_end(inner_handle)
+        finally:
+            self.stats.record_op(
+                "encode",
+                self.name,
+                nbytes,
+                dispatch_s + (time.monotonic() - t0),
+            )
+
+    def digest(self, shards):
+        return self._timed(
+            "digest", shards.nbytes, lambda: self.inner.digest(shards)
+        )
+
+    def reconstruct(self, shards, present, data_shards, parity_shards):
+        return self._timed(
+            "reconstruct",
+            shards.nbytes,
+            lambda: self.inner.reconstruct(
+                shards, present, data_shards, parity_shards
+            ),
+        )
+
+
+def instrument(
+    backend: CodecBackend, stats: "KernelStats | None" = None
+) -> InstrumentedBackend:
+    """Wrap a concrete backend with kernel telemetry (idempotent)."""
+    if isinstance(backend, InstrumentedBackend):
+        return backend
+    return InstrumentedBackend(backend, stats)

@@ -238,11 +238,21 @@ class FreshDiskMonitor:
                 for d_idx, disk in enumerate(eset.disks):
                     if disk is None:
                         continue
-                    # probe THROUGH the DiskIDCheck wrapper's inner
-                    # disk: the wrapper (rightly) fails every op on an
-                    # unformatted drive, but this monitor's whole job
-                    # is resurrecting exactly those drives
-                    raw = getattr(disk, "unwrapped", disk)
+                    # probe THROUGH the decorator chain (DiskIDCheck,
+                    # MeteredDisk - in either stacking order) to the
+                    # raw disk: the ID check (rightly) fails every op
+                    # on an unformatted drive, but this monitor's
+                    # whole job is resurrecting exactly those drives
+                    raw = disk
+                    while True:
+                        inner = (
+                            raw.__dict__.get("unwrapped")
+                            if hasattr(raw, "__dict__")
+                            else None
+                        )
+                        if inner is None:
+                            break
+                        raw = inner
                     # stamped at boot (load_or_init_format hole fill):
                     # still needs its set swept
                     if getattr(raw, "_freshly_stamped", False):

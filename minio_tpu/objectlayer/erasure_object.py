@@ -66,7 +66,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
     ):
         if len(disks) < 2:
             raise ValueError("erasure set needs >= 2 disks")
-        self.disks = list(disks)
+        from ..storage import metered
+
+        # per-disk API telemetry rides on every erasure set; wrap() is
+        # idempotent, so construction sites that already stacked
+        # DiskIDCheck(MeteredDisk(...)) pass through untouched
+        self.disks = [metered.wrap(d) for d in disks]
         n = len(disks)
         self.parity_blocks = (
             parity_blocks if parity_blocks is not None else n // 2

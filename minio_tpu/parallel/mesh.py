@@ -29,6 +29,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map only exists as a top-level alias in newer releases;
+# older ones (e.g. 0.4.x) ship it under jax.experimental.shard_map with
+# the replication check spelled `check_rep` instead of `check_vma`
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _exp_shard_map(
+            f, mesh, in_specs, out_specs, check_rep=check_vma
+        )
+
 from ..ops import gf, rs
 
 
@@ -66,7 +78,10 @@ def xor_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
     log2(n) ppermute steps (falls back to all-gather+fold for non powers
     of two).
     """
-    n = jax.lax.axis_size(axis_name)
+    # lax.axis_size is missing on older releases; psum of a unit is the
+    # portable spelling and stays a static int under shard_map
+    _axis_size = getattr(jax.lax, "axis_size", None)
+    n = _axis_size(axis_name) if _axis_size else jax.lax.psum(1, axis_name)
     if n == 1:
         return x
     if n & (n - 1) == 0:
@@ -131,7 +146,7 @@ def sharded_encode(
         total = xor_allreduce(partial, "shard")
         return rs.words_to_bytes(total)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         step,
         mesh=mesh,
         in_specs=P("stripe", "shard", None),
@@ -156,7 +171,7 @@ def sharded_encode_seq(mesh: Mesh, data: jax.Array, parity_shards: int) -> jax.A
         words = rs.bytes_to_words(local)
         return rs.words_to_bytes(rs._encode_words(words, matrix))
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         step,
         mesh=mesh,
         in_specs=P(None, ("stripe", "shard")),
@@ -247,7 +262,7 @@ def _encode_hash_fn(mesh: Mesh, k: int, m: int, shard_len: int):
         return parity, ddig, pdig
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             step,
             mesh=mesh,
             in_specs=P("stripe", "shard", None),
@@ -312,7 +327,7 @@ def _reconstruct_fn(mesh: Mesh, k: int, m: int, idx: tuple[int, ...]):
         return xor_allreduce(partial, "shard")
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             step,
             mesh=mesh,
             in_specs=P("stripe", "shard", None),
@@ -359,7 +374,7 @@ def _digest_fn(mesh: Mesh, shard_len: int):
         return phash.phash256_words_batched(local, shard_len)
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             step,
             mesh=mesh,
             in_specs=P(("stripe", "shard"), None),

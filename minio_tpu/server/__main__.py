@@ -117,7 +117,11 @@ def build_cluster(
             timeout_s=format_timeout_s,
         )
         # per-op disk identity validation on local drives
-        # (xl-storage-disk-id-check.go): a swapped drive fails fast
+        # (xl-storage-disk-id-check.go): a swapped drive fails fast.
+        # Metering sits INSIDE the identity check so the heal
+        # subsystem's one-hop `unwrapped` probe of unformatted drives
+        # still reaches the raw disk (storage/metered.py docstring).
+        from ..storage import metered
         from ..storage.diskcheck import DiskIDCheck
 
         guarded = []
@@ -125,7 +129,9 @@ def build_cluster(
             if d is not None and d.is_local():
                 s_idx, d_idx = divmod(i, drives_per_set)
                 guarded.append(
-                    DiskIDCheck(d, ref_fmt.sets[s_idx][d_idx])
+                    DiskIDCheck(
+                        metered.wrap(d), ref_fmt.sets[s_idx][d_idx]
+                    )
                 )
             else:
                 guarded.append(d)

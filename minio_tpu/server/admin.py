@@ -108,6 +108,12 @@ class AdminAPI:
             if stats_fn is None:
                 return 200, _json({"enabled": False})
             return 200, _json({"enabled": True, **stats_fn()})
+        # codec kernel telemetry dump (codec/telemetry.py): per-op
+        # calls/bytes/device-seconds, batcher occupancy, stream totals
+        if route == ("GET", "kernel-stats"):
+            from ..codec.telemetry import KERNEL_STATS
+
+            return 200, _json(KERNEL_STATS.snapshot())
         # profiling (admin-router.go:82): start on every node, download
         # collects per-node artifacts in one JSON document
         if route == ("POST", "profiling/start"):
@@ -444,6 +450,14 @@ class AdminAPI:
                 entry["state"] = "ok"
             except Exception as e:  # noqa: BLE001
                 entry["state"] = f"error: {type(e).__name__}"
+            # lifetime per-API ledger when a MeteredDisk is in the
+            # wrapper chain (storage/metered.py)
+            stats_fn = getattr(d, "api_stats", None)
+            if callable(stats_fn):
+                try:
+                    entry["api_stats"] = stats_fn()
+                except Exception:  # noqa: BLE001
+                    pass
             return entry
 
         local = [

@@ -526,6 +526,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def send_response(self, code, message=None):
         self._last_status = code  # metrics middleware reads this
+        # first status line of the request = first byte on the wire
+        # (the TTFB sample; streaming bodies start right after it)
+        if (
+            getattr(self, "_t_start", None) is not None
+            and getattr(self, "_ttfb", None) is None
+        ):
+            self._ttfb = _time.monotonic() - self._t_start
         super().send_response(code, message)
 
     def _finish_body(self) -> None:
@@ -567,6 +574,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._action = ""
         self._last_status = 0
         self._resp_bytes = 0
+        self._t_start = None
+        self._ttfb = None
         if self.command not in ("GET", "PUT", "POST", "DELETE", "HEAD"):
             # non-S3 verbs (PATCH, OPTIONS, PROPFIND, ...) answer the
             # S3 MethodNotAllowed document - with the body drained for
@@ -611,6 +620,7 @@ class _Handler(BaseHTTPRequestHandler):
                     self.s3.object_layer,
                     self.s3.heal_routine,
                     self.s3.heal_queue,
+                    audit=self.s3.audit,
                 ),
                 content_type="text/plain; version=0.0.4",
             )
@@ -621,6 +631,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(s3errors.get("SlowDown"), path)
             return
         t0 = _time.monotonic()
+        self._t_start = t0
         try:
             from . import web as webmod
 
@@ -657,6 +668,7 @@ class _Handler(BaseHTTPRequestHandler):
                 dur,
                 bytes_in=cl,
                 bytes_out=self._resp_bytes,
+                ttfb=self._ttfb,
             )
             self._emit_trace_audit(path, query, dur, cl)
 

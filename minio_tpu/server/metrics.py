@@ -1,17 +1,91 @@
 """Prometheus metrics (cmd/metrics.go:66-507).
 
 A process-local registry fed by the request middleware plus live
-gauges scraped from the object layer (per-disk usage) and the heal
-routine, rendered in the Prometheus text exposition format at
-``/minio-tpu/prometheus/metrics``.
+gauges scraped from the object layer (per-disk usage + per-API disk
+latencies), the heal routine, the codec kernel telemetry registry
+(codec/telemetry.py), and the audit log, rendered in the Prometheus
+text exposition format 0.0.4 at ``/minio-tpu/prometheus/metrics``.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 
 START_TIME = time.time()
+
+# Serving-path latency distributions (cmd/metrics.go httpRequestsDuration).
+# TTFB buckets reach lower: first byte on a cache/metadata hit is sub-ms.
+DURATION_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+TTFB_BUCKETS = (
+    0.001, 0.003, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+def _escape_label(v) -> str:
+    """Label-value escaping per the text-format spec: backslash first."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(v: str) -> str:
+    """HELP text allows everything except raw newlines and backslashes."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_bound(b: float) -> str:
+    """Bucket boundary as Prometheus renders it: 0.05, 1, 2.5."""
+    return format(b, "g")
+
+
+class Histogram:
+    """Thread-safe fixed-bucket histogram keyed by one label value.
+
+    Observations land in the first bucket whose upper bound is >= the
+    value (``le`` semantics); values beyond the last bound go to the
+    implicit ``+Inf`` overflow slot.  ``collect()`` returns cumulative
+    bucket counts ready for ``_bucket``/``_sum``/``_count`` rendering.
+    """
+
+    def __init__(self, buckets: "tuple[float, ...]"):
+        self.buckets = tuple(sorted(buckets))
+        self._mu = threading.Lock()
+        # key -> [per-bucket counts..., overflow]
+        self._counts: "dict[str, list[int]]" = {}
+        self._sums: "dict[str, float]" = {}
+
+    def observe(self, key: str, value: float) -> None:
+        if value < 0:
+            value = 0.0
+        idx = bisect_left(self.buckets, value)
+        with self._mu:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
+            counts[idx] += 1
+            self._sums[key] += value
+
+    def collect(self):
+        """Per key: (cumulative bucket counts incl. +Inf, sum, count)."""
+        with self._mu:
+            snap = {
+                k: (list(v), self._sums[k]) for k, v in self._counts.items()
+            }
+        out = []
+        for key in sorted(snap):
+            counts, total = snap[key]
+            cum, acc = [], 0
+            for c in counts:
+                acc += c
+                cum.append(acc)
+            out.append((key, cum, total, acc))
+        return out
 
 
 class Metrics:
@@ -25,6 +99,8 @@ class Metrics:
         self.latency: "dict[str, list]" = {}
         self.bytes_rx = 0
         self.bytes_tx = 0
+        self.duration_hist = Histogram(DURATION_BUCKETS)
+        self.ttfb_hist = Histogram(TTFB_BUCKETS)
 
     def observe(
         self,
@@ -33,6 +109,7 @@ class Metrics:
         seconds: float,
         bytes_in: int = 0,
         bytes_out: int = 0,
+        ttfb: "float | None" = None,
     ) -> None:
         with self._mu:
             key = (api, str(code))
@@ -42,25 +119,46 @@ class Metrics:
             lat[1] += seconds
             self.bytes_rx += bytes_in
             self.bytes_tx += bytes_out
+        self.duration_hist.observe(api, seconds)
+        if ttfb is not None:
+            self.ttfb_hist.observe(api, ttfb)
 
     # -- rendering --------------------------------------------------------
 
-    def render(self, object_layer=None, heal=None, queue=None) -> bytes:
+    def render(
+        self, object_layer=None, heal=None, queue=None, audit=None
+    ) -> bytes:
         """The exposition document; live gauges are sampled now."""
         out: list[str] = []
 
         def emit(name, mtype, help_, samples):
-            out.append(f"# HELP {name} {help_}")
+            out.append(f"# HELP {name} {_escape_help(help_)}")
             out.append(f"# TYPE {name} {mtype}")
             for labels, value in samples:
                 lbl = (
                     "{"
-                    + ",".join(f'{k}="{v}"' for k, v in labels.items())
+                    + ",".join(
+                        f'{k}="{_escape_label(v)}"'
+                        for k, v in labels.items()
+                    )
                     + "}"
                     if labels
                     else ""
                 )
                 out.append(f"{name}{lbl} {value}")
+
+        def emit_histogram(name, help_, hist, label):
+            out.append(f"# HELP {name} {_escape_help(help_)}")
+            out.append(f"# TYPE {name} histogram")
+            for key, cum, total, count in hist.collect():
+                kv = f'{label}="{_escape_label(key)}"'
+                for bound, c in zip(hist.buckets, cum):
+                    out.append(
+                        f'{name}_bucket{{{kv},le="{_fmt_bound(bound)}"}} {c}'
+                    )
+                out.append(f'{name}_bucket{{{kv},le="+Inf"}} {count}')
+                out.append(f"{name}_sum{{{kv}}} {total:.6f}")
+                out.append(f"{name}_count{{{kv}}} {count}")
 
         with self._mu:
             reqs = dict(self.requests)
@@ -91,6 +189,18 @@ class Metrics:
             "Requests counted toward request_seconds by API",
             [({"api": api}, n) for api, (n, _t) in sorted(lat.items())],
         )
+        emit_histogram(
+            "miniotpu_s3_request_duration_seconds",
+            "S3 request wall-time distribution by API",
+            self.duration_hist,
+            "api",
+        )
+        emit_histogram(
+            "miniotpu_s3_ttfb_seconds",
+            "Time to first response byte by API",
+            self.ttfb_hist,
+            "api",
+        )
         emit(
             "miniotpu_s3_rx_bytes_total", "counter",
             "Bytes received from S3 clients", [({}, rx)],
@@ -104,6 +214,8 @@ class Metrics:
             "Seconds since process start",
             [({}, f"{time.time() - START_TIME:.1f}")],
         )
+
+        self._emit_codec(emit)
 
         if object_layer is not None:
             disks, usage = _disk_samples(object_layer)
@@ -130,6 +242,7 @@ class Metrics:
                 "Capacity per disk",
                 [({"disk": ep}, t) for ep, (_u, _f, t) in usage],
             )
+            self._emit_disk_api(emit, object_layer)
         if heal is not None:
             emit(
                 "miniotpu_heal_objects_healed_total", "counter",
@@ -147,7 +260,114 @@ class Metrics:
                 "Tasks waiting in the heal queue",
                 [({}, len(queue))],
             )
+        if audit is not None:
+            emit(
+                "miniotpu_audit_entries_dropped_total", "counter",
+                "Audit entries lost to target write failures",
+                [({}, getattr(audit, "dropped", 0))],
+            )
         return ("\n".join(out) + "\n").encode()
+
+    @staticmethod
+    def _emit_codec(emit):
+        """Codec kernel families from the process-wide KernelStats."""
+        from ..codec.telemetry import KERNEL_STATS
+
+        snap = KERNEL_STATS.snapshot()
+        ops = snap["ops"]
+        emit(
+            "miniotpu_codec_ops_total", "counter",
+            "Codec backend kernel invocations by op and backend",
+            [
+                ({"op": o["op"], "backend": o["backend"]}, o["calls"])
+                for o in ops
+            ],
+        )
+        emit(
+            "miniotpu_codec_bytes_total", "counter",
+            "Bytes processed by codec kernels by op and backend",
+            [
+                ({"op": o["op"], "backend": o["backend"]}, o["bytes"])
+                for o in ops
+            ],
+        )
+        emit(
+            "miniotpu_codec_seconds_total", "counter",
+            "Host-observed device seconds in codec kernels",
+            [
+                (
+                    {"op": o["op"], "backend": o["backend"]},
+                    f'{o["seconds"]:.6f}',
+                )
+                for o in ops
+            ],
+        )
+        b = snap["batch"]
+        emit(
+            "miniotpu_codec_batch_flushes_total", "counter",
+            "Coalesced codec batch flushes", [({}, b["flushes"])],
+        )
+        emit(
+            "miniotpu_codec_batch_jobs_total", "counter",
+            "Jobs coalesced into codec batch flushes",
+            [({}, b["jobs"])],
+        )
+        emit(
+            "miniotpu_codec_batch_blocks_total", "counter",
+            "Blocks merged across codec batch flushes",
+            [({}, b["blocks"])],
+        )
+        emit(
+            "miniotpu_codec_batch_wait_seconds_total", "counter",
+            "Cumulative queue wait across coalesced codec jobs",
+            [({}, f'{b["wait_seconds"]:.6f}')],
+        )
+        streams = snap["streams"]
+        emit(
+            "miniotpu_codec_streams_total", "counter",
+            "Erasure-coded object streams by kind",
+            [({"op": s["kind"]}, s["streams"]) for s in streams],
+        )
+        emit(
+            "miniotpu_codec_stream_bytes_total", "counter",
+            "Object bytes pushed through erasure streams by kind",
+            [({"op": s["kind"]}, s["bytes"]) for s in streams],
+        )
+        emit(
+            "miniotpu_codec_stream_heal_required_total", "counter",
+            "Decoded streams that reported shards needing heal",
+            [({}, snap["heal_required"])],
+        )
+
+    @staticmethod
+    def _emit_disk_api(emit, object_layer):
+        """Per-disk per-API families from any MeteredDisk in the layer."""
+        calls, errors, seconds = [], [], []
+        for d in _iter_disks(object_layer):
+            stats_fn = getattr(d, "api_stats", None)
+            if not callable(stats_fn):
+                continue
+            try:
+                ep, stats = d.metered_endpoint(), stats_fn()
+            except Exception:  # noqa: BLE001
+                continue
+            for api, row in sorted(stats.items()):
+                kv = {"disk": ep, "api": api}
+                calls.append((kv, row["calls"]))
+                errors.append((kv, row["errors"]))
+                seconds.append((kv, f'{row["seconds"]:.6f}'))
+        emit(
+            "miniotpu_disk_api_calls_total", "counter",
+            "Storage API calls by disk and API", calls,
+        )
+        emit(
+            "miniotpu_disk_api_errors_total", "counter",
+            "Storage API errors by disk and API", errors,
+        )
+        emit(
+            "miniotpu_disk_api_seconds_total", "counter",
+            "Cumulative storage API latency by disk and API", seconds,
+        )
 
 
 def _iter_disks(object_layer):

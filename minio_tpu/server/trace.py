@@ -13,7 +13,6 @@ mechanism for `admin console`.
 
 from __future__ import annotations
 
-import collections
 import json
 import logging
 import os
@@ -26,17 +25,29 @@ RING_MAX = 4096
 
 
 class SeqRing:
-    """Sequence-numbered ring buffer; readers poll with `since`."""
+    """Sequence-numbered ring buffer; readers poll with `since`.
+
+    Sequences are contiguous (each append is +1), so a reader's cursor
+    maps to a buffer offset arithmetically: `since` is O(returned)
+    rather than a full-ring scan - peers polling `tracebuf?since=N`
+    were rescanning all 4096 entries per poll per peer.
+    """
 
     def __init__(self, maxlen: int = RING_MAX):
         self._mu = threading.Lock()
-        self._buf: collections.deque = collections.deque(maxlen=maxlen)
+        self._maxlen = maxlen
+        self._buf: list = []
+        self._head = 0  # index of the OLDEST retained item once full
         self._seq = 0
 
     def append(self, item: dict) -> int:
         with self._mu:
             self._seq += 1
-            self._buf.append((self._seq, item))
+            if len(self._buf) < self._maxlen:
+                self._buf.append(item)
+            else:
+                self._buf[self._head] = item
+                self._head = (self._head + 1) % self._maxlen
             return self._seq
 
     def since(self, seq: int, limit: int = 1000) -> "tuple[int, list]":
@@ -45,9 +56,15 @@ class SeqRing:
         truncates, the remainder is picked up by the next poll rather
         than silently skipped."""
         with self._mu:
-            pairs = [(s, it) for s, it in self._buf if s > seq][:limit]
-            cursor = pairs[-1][0] if pairs else self._seq
-            return cursor, [it for _, it in pairs]
+            n = len(self._buf)
+            first = self._seq - n + 1  # seq of the oldest retained item
+            start = max(seq + 1, first)
+            if n == 0 or start > self._seq:
+                return self._seq, []
+            count = min(self._seq - start + 1, limit)
+            base = self._head + (start - first)
+            items = [self._buf[(base + i) % n] for i in range(count)]
+            return start + count - 1, items
 
 
 class Tracer:
@@ -115,6 +132,10 @@ class AuditLog:
             "MINIO_TPU_AUDIT_LOG_FILE", ""
         )
         self._mu = threading.Lock()
+        # write failures: counted (miniotpu_audit_entries_dropped_total)
+        # and warned about once, not silently swallowed
+        self.dropped = 0
+        self._warned = False
 
     @property
     def enabled(self) -> bool:
@@ -129,8 +150,19 @@ class AuditLog:
         try:
             with self._mu, open(self.path, "a", encoding="utf-8") as f:
                 f.write(line)
-        except OSError:
-            pass
+        except OSError as exc:
+            with self._mu:
+                self.dropped += 1
+                warn = not self._warned
+                self._warned = True
+            if warn:
+                logging.getLogger("minio_tpu.audit").warning(
+                    "audit log write failed; entries are being dropped "
+                    "(target=%s error=%s) - further drops counted in "
+                    "miniotpu_audit_entries_dropped_total",
+                    self.path,
+                    exc,
+                )
 
 
 class ConsoleCapture(logging.Handler):

@@ -1,0 +1,109 @@
+"""Per-disk storage API instrumentation (cmd/xl-storage-disk-id-check.go).
+
+``MeteredDisk`` wraps any StorageAPI and records, per API endpoint:
+call counts, error counts, and cumulative latency.  The reference keeps
+the same ledger in its disk-ID-check decorator (storageMetrics /
+getMetrics); here metering is its own layer so it composes with
+``DiskIDCheck`` explicitly.
+
+Stacking order matters: ``DiskIDCheck(MeteredDisk(xl))``.  The heal
+subsystem reaches the RAW disk via one ``getattr(disk, "unwrapped")``
+hop to probe/re-stamp unformatted drives (heal/background.py); with
+metering innermost that hop lands on the MeteredDisk, whose
+passthrough still reaches the drive while identity checks stay
+outermost.  ``wrap()`` is idempotent and walks existing wrapper chains
+so construction sites and the object layer can both call it safely.
+
+Exported as ``miniotpu_disk_api_{calls,errors,seconds}_total`` with
+``disk``/``api`` labels (server/metrics.py) and folded into
+``admin healthinfo`` drive entries (server/admin.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .diskcheck import DiskIDCheck
+
+
+class MeteredDisk:
+    """StorageAPI decorator keeping a per-API call/error/latency ledger."""
+
+    # the drive-touching surface (DiskIDCheck._CHECKED, same contract)
+    _METERED = DiskIDCheck._CHECKED
+
+    def __init__(self, disk):
+        self.unwrapped = disk
+        self._stats_mu = threading.Lock()
+        # api -> [calls, errors, seconds]
+        self._stats: "dict[str, list]" = {}
+
+    def metered_endpoint(self) -> str:
+        """Stable disk label for exported series."""
+        try:
+            return str(self.unwrapped.endpoint())
+        except Exception:  # noqa: BLE001
+            return str(getattr(self.unwrapped, "root", "?"))
+
+    def api_stats(self) -> "dict[str, dict]":
+        """Ledger snapshot: api -> {calls, errors, seconds}."""
+        with self._stats_mu:
+            return {
+                api: {
+                    "calls": calls,
+                    "errors": errors,
+                    "seconds": round(secs, 6),
+                }
+                for api, (calls, errors, secs) in self._stats.items()
+            }
+
+    def _record(self, api: str, seconds: float, failed: bool) -> None:
+        with self._stats_mu:
+            row = self._stats.setdefault(api, [0, 0, 0.0])
+            row[0] += 1
+            if failed:
+                row[1] += 1
+            row[2] += seconds
+
+    def __getattr__(self, name: str):
+        attr = getattr(self.unwrapped, name)
+        if name in self._METERED and callable(attr):
+            def wrapped(*a, **k):
+                t0 = time.monotonic()
+                ok = False
+                try:
+                    result = attr(*a, **k)
+                    ok = True
+                    return result
+                finally:
+                    self._record(name, time.monotonic() - t0, not ok)
+
+            wrapped.__name__ = name
+            # cache the bound wrapper: __getattr__ only fires on miss,
+            # so the hot path pays the timing closure, not the lookup
+            self.__dict__[name] = wrapped
+            return wrapped
+        return attr
+
+
+def is_metered(disk) -> bool:
+    """True if a MeteredDisk sits anywhere in the wrapper chain.
+
+    Walks ``unwrapped`` links via ``__dict__`` lookups only - going
+    through ``getattr`` would trip the wrappers' own ``__getattr__``
+    forwarding on the innermost (raw) disk.
+    """
+    d = disk
+    while d is not None:
+        if isinstance(d, MeteredDisk):
+            return True
+        d = d.__dict__.get("unwrapped") if hasattr(d, "__dict__") else None
+    return False
+
+
+def wrap(disk):
+    """Meter a disk unless it (or an inner layer) already is; None-safe."""
+    if disk is None or is_metered(disk):
+        return disk
+    return MeteredDisk(disk)

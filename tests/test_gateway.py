@@ -189,6 +189,9 @@ def test_gateway_keys_needing_url_encoding(gw):
 def test_gateway_sse_c_round_trip(upstream, gw, monkeypatch):
     """VERDICT r4 #5: SSE-C passes THROUGH the gateway - the upstream
     owns the encryption; the gateway forwards the customer key."""
+    pytest.importorskip(
+        "cryptography", reason="SSE needs real AES-GCM primitives"
+    )
     import io
 
     from minio_tpu.codec import kms, sse as ssemod
@@ -297,6 +300,9 @@ def test_gateway_front_server_ssec(upstream, tmp_path, monkeypatch):
     """r5 review: SSE-C objects must be readable THROUGH the fronting
     server (client -> gateway server -> upstream), which forwards the
     customer key instead of running local SSE guards."""
+    pytest.importorskip(
+        "cryptography", reason="SSE needs real AES-GCM primitives"
+    )
     import base64
     import hashlib as hl
 

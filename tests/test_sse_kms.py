@@ -11,6 +11,10 @@ import threading
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="SSE needs real AES-GCM primitives"
+)
+
 from minio_tpu.codec import kms as kmsmod
 from minio_tpu.codec import sse as ssemod
 from minio_tpu.objectlayer.erasure_object import ErasureObjects

@@ -185,6 +185,65 @@ def test_seqring_truncation_keeps_cursor():
     assert r.since(seq)[1] == []
 
 
+def test_seqring_paging_across_eviction():
+    """Cursor paging stays exact while the ring evicts underneath:
+    the circular-buffer `since` must return precisely the retained
+    window, oldest first, regardless of where the head wrapped."""
+    r = SeqRing(maxlen=8)
+    for i in range(1, 21):
+        r.append({"n": i})
+    # everything before seq 13 was evicted (only 8 newest retained)
+    seq, items = r.since(5)
+    assert [i["n"] for i in items] == list(range(13, 21))
+    assert seq == 20
+    # a cursor inside the retained window pages normally
+    seq, items = r.since(15, limit=3)
+    assert [i["n"] for i in items] == [16, 17, 18] and seq == 18
+    seq, items = r.since(seq, limit=3)
+    assert [i["n"] for i in items] == [19, 20] and seq == 20
+    # a cursor at/past the tip returns nothing, cursor pinned at tip
+    assert r.since(20) == (20, [])
+    assert r.since(99) == (20, [])
+
+
+def test_audit_drop_counted_and_warned(tmp_path):
+    """Audit write failures are counted (exported as
+    miniotpu_audit_entries_dropped_total) and warned about ONCE
+    through the minio_tpu logger, not silently swallowed."""
+    import logging
+
+    from minio_tpu.server.metrics import Metrics
+    from minio_tpu.server.trace import AuditLog
+
+    # capture on the logger itself: utils.log.setup() turns off
+    # propagation for the minio_tpu tree, so a root-attached caplog
+    # handler would miss these records
+    records = []
+    handler = logging.Handler(level=logging.WARNING)
+    handler.emit = records.append
+    lg = logging.getLogger("minio_tpu.audit")
+    lg.addHandler(handler)
+    try:
+        audit = AuditLog(
+            path=str(tmp_path / "no-such-dir" / "audit.jsonl")
+        )
+        audit.log({"api": {"name": "PutObject"}})
+        audit.log({"api": {"name": "GetObject"}})
+    finally:
+        lg.removeHandler(handler)
+    assert audit.dropped == 2
+    warnings = [
+        rec for rec in records if "audit log write failed" in rec.getMessage()
+    ]
+    assert len(warnings) == 1  # warn once, count forever
+    doc = Metrics().render(audit=audit).decode()
+    assert "miniotpu_audit_entries_dropped_total 2" in doc
+    # a working target drops nothing
+    ok = AuditLog(path=str(tmp_path / "audit.jsonl"))
+    ok.log({"api": {"name": "PutObject"}})
+    assert ok.dropped == 0
+
+
 def test_console_capture_uninstall_on_shutdown(server):
     import logging
 
