@@ -381,7 +381,24 @@ def _kernel_stats_snapshot():
 
 
 def main() -> None:
+    import argparse
     import os
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--no-instrument",
+        action="store_true",
+        help="skip the codec telemetry wrapper (codec/telemetry.py) so "
+        "the benchmark measures the bare backend; detail.kernel_stats "
+        "then reflects only what ran before the flag took effect "
+        "(i.e. nothing)",
+    )
+    args = ap.parse_args()
+    if args.no_instrument:
+        os.environ["MINIO_TPU_NO_INSTRUMENT"] = "1"
+        from minio_tpu.codec import backend as backend_mod
+
+        backend_mod.reset_backend()  # drop any already-wrapped singleton
 
     cpu = bench_cpu_baseline()
     # e2e config #2 (BASELINE.md): through the object layer.  Two codec

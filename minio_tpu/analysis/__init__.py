@@ -1,0 +1,104 @@
+"""minio_tpu.analysis: project-native static analysis.
+
+Three passes over the codebase's invariants (the Python/JAX stand-ins
+for the go-vet / staticcheck / race-detector triad the reference MinIO
+leans on):
+
+* ``hotpath_lint``    — AST rules MTPU101-105 (syncs, retrace bombs,
+  swallowed exceptions, metric conventions);
+* ``kernel_contracts``— abstract-eval contracts MTPU201-204 for every
+  jitted codec entry point (CPU-only, via jax.eval_shape);
+* ``lockorder``       — runtime lock-graph audit MTPU301-302.
+
+Run ``python -m minio_tpu.analysis`` (tier-1 runs the same passes via
+tests/test_analysis.py).  Suppress a deliberate violation with
+``# noqa: MTPU###`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .findings import RULES, Finding, filter_suppressed  # noqa: F401
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# the analyzers do not lint themselves (fixture-ish rule text and the
+# deliberately-broad exception guards around abstract eval would need a
+# noqa forest) — mirrors how linters ship their own excludes.
+_EXCLUDE_PREFIXES = ("minio_tpu/analysis/",)
+
+
+def iter_py_files(paths: "list[str] | None" = None) -> "list[str]":
+    """Repo-relative .py files under ``paths`` (default: minio_tpu/)."""
+    roots = paths or ["minio_tpu"]
+    out: "list[str]" = []
+    for root in roots:
+        abs_root = os.path.join(REPO_ROOT, root)
+        if os.path.isfile(abs_root):
+            out.append(os.path.relpath(abs_root, REPO_ROOT))
+            continue
+        for dirpath, dirnames, filenames in os.walk(abs_root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.append(
+                        os.path.relpath(
+                            os.path.join(dirpath, fn), REPO_ROOT
+                        )
+                    )
+    out = [p.replace(os.sep, "/") for p in out]
+    return sorted(p for p in out if not p.startswith(_EXCLUDE_PREFIXES))
+
+
+def _read_lines(rel_path: str) -> "list[str]":
+    with open(
+        os.path.join(REPO_ROOT, rel_path), encoding="utf-8"
+    ) as fh:
+        return fh.read().splitlines()
+
+
+def run_lint(paths: "list[str] | None" = None) -> "list[Finding]":
+    """Hot-path lint over the tree, noqa-filtered and stable-sorted."""
+    from .hotpath_lint import lint_source
+
+    findings: "list[Finding]" = []
+    sources: "dict[str, list[str]]" = {}
+    for rel in iter_py_files(paths):
+        lines = _read_lines(rel)
+        sources[rel] = lines
+        findings.extend(lint_source(rel, "\n".join(lines) + "\n"))
+    return sorted(
+        filter_suppressed(findings, sources), key=Finding.sort_key
+    )
+
+
+def run_contracts() -> "list[Finding]":
+    """Kernel contract checks (jax.eval_shape; CPU is fine)."""
+    from . import kernel_contracts
+
+    return sorted(kernel_contracts.run(), key=Finding.sort_key)
+
+
+def run_locks() -> "list[Finding]":
+    """Lock-order audit over the built-in CLI scenario."""
+    from .lockorder import run_builtin_scenario
+
+    return sorted(run_builtin_scenario(), key=Finding.sort_key)
+
+
+def run_all(
+    paths: "list[str] | None" = None,
+    skip: "set[str] | None" = None,
+) -> "list[Finding]":
+    skip = skip or set()
+    findings: "list[Finding]" = []
+    if "lint" not in skip:
+        findings.extend(run_lint(paths))
+    if "contracts" not in skip:
+        findings.extend(run_contracts())
+    if "locks" not in skip:
+        findings.extend(run_locks())
+    return sorted(findings, key=Finding.sort_key)

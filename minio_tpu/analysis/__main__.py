@@ -1,0 +1,83 @@
+"""CLI: ``python -m minio_tpu.analysis [--paths ...] [--json] [--skip ...]``.
+
+Exit status 0 when the tree is clean, 1 when any finding survives noqa
+filtering — the same contract tier-1 enforces through
+tests/test_analysis.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    # contract checks must not require an accelerator
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from . import RULES, run_all
+
+    ap = argparse.ArgumentParser(
+        prog="python -m minio_tpu.analysis",
+        description="minio-tpu project-native static analysis "
+        "(hot-path lint, kernel contracts, lock-order audit)",
+    )
+    ap.add_argument(
+        "--paths",
+        nargs="*",
+        default=None,
+        help="repo-relative files/dirs to lint (default: minio_tpu/); "
+        "contract and lock passes are tree-global regardless",
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit findings as a stable-sorted JSON array (diffable)",
+    )
+    ap.add_argument(
+        "--skip",
+        nargs="*",
+        default=[],
+        choices=["lint", "contracts", "locks"],
+        help="passes to skip",
+    )
+    ap.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the MTPU rule catalog and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    findings = run_all(paths=args.paths, skip=set(args.skip))
+
+    if args.json:
+        print(
+            json.dumps(
+                [f.to_dict() for f in findings], indent=2, sort_keys=True
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        ran = [
+            p
+            for p in ("lint", "contracts", "locks")
+            if p not in set(args.skip)
+        ]
+        print(
+            f"minio_tpu.analysis: {len(findings)} finding(s) "
+            f"[{', '.join(ran)}]",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

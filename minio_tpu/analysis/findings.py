@@ -1,0 +1,122 @@
+"""Findings, the rule catalog, and inline suppression.
+
+The project-native analogue of Go's vet/staticcheck diagnostics: every
+analyzer pass (hot-path lint, kernel contract checker, lock-order
+auditor) emits ``Finding`` records carrying a stable ``MTPU###`` rule id
+so future PRs can diff reports, gate CI on exact rule sets, and suppress
+individual sites with ``# noqa: MTPU###`` where a violation is a
+documented, deliberate exception.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+# Rule catalog.  1xx = hot-path lint (AST), 2xx = kernel contract
+# checker (abstract eval), 3xx = lock-order auditor (runtime shim).
+RULES: "dict[str, str]" = {
+    "MTPU101": (
+        "host-device sync (block_until_ready / jax.device_get / .item() / "
+        "np.asarray of a traced value) inside jit-traced code or a "
+        "device-only module"
+    ),
+    "MTPU102": (
+        "retrace bomb: jax.jit function takes a non-array Python "
+        "parameter (int/str/bool/bytes/float/tuple annotation) not "
+        "routed through static_argnames/static_argnums"
+    ),
+    "MTPU103": (
+        "silently swallowed failure: `except Exception/BaseException/"
+        "bare except` whose body is only pass/..."
+    ),
+    "MTPU104": (
+        "prometheus metric-name convention: family must be "
+        "miniotpu_-prefixed lowercase, counters must end in _total"
+    ),
+    "MTPU105": (
+        "prometheus label-key hygiene: label keys must match "
+        "[a-z_][a-z0-9_]*"
+    ),
+    "MTPU201": "kernel contract: wrong output dtype from a jitted entry point",
+    "MTPU202": "kernel contract: wrong output shape from a jitted entry point",
+    "MTPU203": (
+        "kernel contract: encode->reconstruct shape round-trip broken"
+    ),
+    "MTPU204": (
+        "kernel contract: jitted entry point in minio_tpu/ops has no "
+        "registered contract check"
+    ),
+    "MTPU301": "lock-order cycle in the observed acquisition graph",
+    "MTPU302": (
+        "blocking call (sleep / socket connect / subprocess) while "
+        "holding a registered hot-path lock"
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: rule id + location + message.
+
+    ``path`` is repo-relative where the finding is file-anchored;
+    runtime passes anchor at the closest code object they can name.
+    """
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule, self.message)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*))?",
+)
+
+
+def noqa_codes_for_line(line: str) -> "set[str] | None":
+    """Suppression codes on a source line.
+
+    Returns None when the line carries no noqa directive, the empty set
+    for a bare ``# noqa`` (suppress everything), else the specific codes.
+    """
+    m = _NOQA_RE.search(line)
+    if m is None:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return set()
+    return {c.strip() for c in codes.split(",")}
+
+
+def filter_suppressed(
+    findings: "list[Finding]", source_lines: "dict[str, list[str]]"
+) -> "list[Finding]":
+    """Drop findings whose source line carries a matching noqa.
+
+    ``source_lines`` maps finding paths to their file's lines; findings
+    for paths not in the map (runtime findings) pass through untouched.
+    """
+    out = []
+    for f in findings:
+        lines = source_lines.get(f.path)
+        if lines is not None and 1 <= f.line <= len(lines):
+            codes = noqa_codes_for_line(lines[f.line - 1])
+            if codes is not None and (not codes or f.rule in codes):
+                continue
+        out.append(f)
+    return out

@@ -1,0 +1,383 @@
+"""Hot-path lint: AST rules encoding this codebase's device invariants.
+
+Go's toolchain keeps the reference honest (vet, staticcheck); these
+rules are the Python/JAX equivalents for the invariants that actually
+bite *this* tree:
+
+* MTPU101 - host-device syncs (``block_until_ready``, ``jax.device_get``,
+  ``.item()``) are forbidden inside jit-traced functions anywhere, and
+  anywhere at all in the device-only modules (``minio_tpu/ops/``,
+  ``minio_tpu/codec/``) outside whitelisted host boundaries (functions
+  named ``host_*``).  ``np.asarray``/``np.array``/``np.ascontiguousarray``
+  on a *traced* value (a jit parameter not routed through
+  ``static_argnames``) is the same sync in disguise and is flagged inside
+  jit bodies; on static parameters it happens at trace time and is fine.
+* MTPU102 - retrace bombs: a ``jax.jit`` function taking a plain-Python
+  parameter (``int``/``str``/``bool``/``bytes``/``float``/``tuple``
+  annotation) that is not listed in ``static_argnames``/``static_argnums``
+  recompiles on every distinct value while hashing it as a tracer.
+* MTPU103 - ``except Exception/BaseException``/bare ``except`` whose body
+  is only ``pass``: the silently-dead-path generator (PR 1's mesh encode
+  path died exactly this way).
+* MTPU104/105 - Prometheus registration conventions at the
+  ``server/metrics.py`` emit sites: ``miniotpu_`` prefix, lowercase
+  names, ``_total`` suffix on counters, ``[a-z_][a-z0-9_]*`` label keys.
+
+Suppress a deliberate exception with ``# noqa: MTPU###`` on the
+offending line (see analysis/findings.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .findings import Finding
+
+# modules whose whole body is device-kernel territory: any host sync is
+# a hot-path stall, not just ones inside jit
+DEVICE_ONLY_PREFIXES = ("minio_tpu/ops/", "minio_tpu/codec/")
+
+# host-boundary functions exempt from the device-module sweep
+_HOST_BOUNDARY_RE = re.compile(r"^(host_|_host)|_host$")
+
+_SYNC_ATTRS = {"block_until_ready", "item"}
+_NP_MATERIALIZE = {"asarray", "array", "ascontiguousarray", "frombuffer"}
+_SCALAR_ANNOTATIONS = {"int", "str", "bool", "bytes", "float", "tuple", "Tuple"}
+
+_METRIC_NAME_RE = re.compile(r"^miniotpu_[a-z0-9_]+$")
+_LABEL_KEY_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+_METRIC_TYPES = {"counter", "gauge", "histogram"}
+
+
+def _dotted(node: ast.AST) -> "str | None":
+    """'jax.device_get' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _root_name(node: ast.AST) -> "str | None":
+    """The base Name of an expression like ``x``, ``x[i]``, ``x.attr``."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _jit_decorator(dec: ast.AST) -> "tuple[bool, set, set] | None":
+    """(is_jit, static_argnames, static_argnums) for one decorator.
+
+    Recognizes ``@jax.jit``, ``@jit``, ``@jax.jit(...)`` and
+    ``@functools.partial(jax.jit, ...)`` / ``@partial(jax.jit, ...)``.
+    """
+    names: "set[str]" = set()
+    nums: "set[int]" = set()
+    target = dec
+    keywords: "list[ast.keyword]" = []
+    if isinstance(dec, ast.Call):
+        fn = _dotted(dec.func)
+        if fn in ("functools.partial", "partial") and dec.args:
+            target = dec.args[0]
+            keywords = dec.keywords
+        else:
+            target = dec.func
+            keywords = dec.keywords
+    d = _dotted(target)
+    if d not in ("jax.jit", "jit"):
+        return None
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    names.add(c.value)
+        elif kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                    nums.add(c.value)
+    return True, names, nums
+
+
+def _annotation_token(ann: "ast.AST | None") -> "str | None":
+    """Leading identifier of an annotation: int, tuple, np, ..."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        m = re.match(r"\s*([A-Za-z_][A-Za-z0-9_]*)", ann.value)
+        return m.group(1) if m else None
+    if isinstance(ann, ast.Subscript):
+        ann = ann.value
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Attribute):
+        return _dotted(ann)
+    return None
+
+
+def _only_pass(body: "list[ast.stmt]") -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, rel_path: str):
+        self.rel_path = rel_path
+        self.device_module = rel_path.startswith(DEVICE_ONLY_PREFIXES)
+        self.findings: "list[Finding]" = []
+        # stack of (func_name, jit_static_names or None)
+        self._funcs: "list[tuple[str, set | None]]" = []
+
+    # -- helpers ----------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(rule, self.rel_path, getattr(node, "lineno", 1), message)
+        )
+
+    def _in_jit(self) -> "set | None":
+        for _name, static in reversed(self._funcs):
+            if static is not None:
+                return static
+        return None
+
+    def _in_host_boundary(self) -> bool:
+        return any(
+            _HOST_BOUNDARY_RE.search(name) for name, _ in self._funcs
+        )
+
+    # -- function defs: jit detection + MTPU102 ---------------------------
+
+    def _visit_func(self, node):
+        static: "set | None" = None
+        for dec in node.decorator_list:
+            parsed = _jit_decorator(dec)
+            if parsed is None:
+                continue
+            _, names, nums = parsed
+            params = [
+                a.arg
+                for a in node.args.posonlyargs + node.args.args
+            ]
+            static = set(names)
+            for i in nums:
+                if i < len(params):
+                    static.add(params[i])
+            self._check_retrace(node, static)
+            break
+        self._funcs.append((node.name, static))
+        self.generic_visit(node)
+        self._funcs.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _check_retrace(self, node, static: "set[str]") -> None:
+        args = node.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if a.arg in ("self", "cls") or a.arg in static:
+                continue
+            token = _annotation_token(a.annotation)
+            if token in _SCALAR_ANNOTATIONS:
+                self._emit(
+                    "MTPU102",
+                    a,
+                    f"jit function {node.name!r} takes Python-{token} "
+                    f"parameter {a.arg!r} outside static_argnames: every "
+                    "distinct value retraces and recompiles",
+                )
+
+    # -- calls: MTPU101 + metric conventions ------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_sync(node)
+        self._check_metric_emit(node)
+        self.generic_visit(node)
+
+    def _check_sync(self, node: ast.Call) -> None:
+        static = self._in_jit()
+        in_jit = static is not None
+        device_scope = (
+            self.device_module and not self._in_host_boundary()
+        )
+        if not in_jit and not device_scope:
+            return
+        where = (
+            "inside jit-traced code" if in_jit else "in a device-only module"
+        )
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _SYNC_ATTRS and not node.args:
+                self._emit(
+                    "MTPU101",
+                    node,
+                    f".{attr}() is a host-device sync {where}; move it "
+                    "to the host boundary",
+                )
+                return
+            dotted = _dotted(node.func)
+            if dotted in ("jax.device_get", "jax.device_put_replicated"):
+                self._emit(
+                    "MTPU101",
+                    node,
+                    f"{dotted} is a host-device sync {where}; move it to "
+                    "the host boundary",
+                )
+                return
+            if (
+                in_jit
+                and dotted is not None
+                and dotted.startswith(("np.", "numpy."))
+                and attr in _NP_MATERIALIZE
+                and node.args
+            ):
+                root = _root_name(node.args[0])
+                if root is not None and root not in static:
+                    top = self._funcs[-1][0] if self._funcs else "<module>"
+                    self._emit(
+                        "MTPU101",
+                        node,
+                        f"np.{attr}({root}...) inside jit function "
+                        f"{top!r} materializes a traced value on host "
+                        "(sync + constant-folding trap); use jnp or mark "
+                        f"{root!r} static",
+                    )
+        elif isinstance(node.func, ast.Name):
+            if node.func.id == "device_get":
+                self._emit(
+                    "MTPU101",
+                    node,
+                    f"device_get is a host-device sync {where}",
+                )
+
+    def _check_metric_emit(self, node: ast.Call) -> None:
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name not in ("emit", "emit_histogram"):
+            return
+        if not node.args or not (
+            isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            return
+        metric = node.args[0].value
+        if name == "emit":
+            if len(node.args) < 2 or not (
+                isinstance(node.args[1], ast.Constant)
+                and node.args[1].value in _METRIC_TYPES
+            ):
+                return  # not a registration-shaped call
+            mtype = node.args[1].value
+        else:
+            mtype = "histogram"
+        if not _METRIC_NAME_RE.match(metric):
+            self._emit(
+                "MTPU104",
+                node,
+                f"metric {metric!r} violates naming: must match "
+                "miniotpu_[a-z0-9_]+",
+            )
+        elif mtype == "counter" and not metric.endswith("_total"):
+            self._emit(
+                "MTPU104",
+                node,
+                f"counter {metric!r} must end in _total "
+                "(prometheus counter convention)",
+            )
+        elif mtype == "histogram" and metric.endswith(
+            ("_total", "_count", "_sum", "_bucket")
+        ):
+            self._emit(
+                "MTPU104",
+                node,
+                f"histogram {metric!r} must not end in a reserved "
+                "series suffix (_total/_count/_sum/_bucket)",
+            )
+        # label-key hygiene: every dict literal key in the sample args
+        for arg in node.args[2:] + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if not isinstance(sub, ast.Dict):
+                    continue
+                for k in sub.keys:
+                    if (
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and not _LABEL_KEY_RE.match(k.value)
+                    ):
+                        self._emit(
+                            "MTPU105",
+                            k,
+                            f"label key {k.value!r} of {metric!r} must "
+                            "match [a-z_][a-z0-9_]*",
+                        )
+        if name == "emit_histogram" and len(node.args) >= 4:
+            lab = node.args[3]
+            if (
+                isinstance(lab, ast.Constant)
+                and isinstance(lab.value, str)
+                and not _LABEL_KEY_RE.match(lab.value)
+            ):
+                self._emit(
+                    "MTPU105",
+                    lab,
+                    f"label key {lab.value!r} of {metric!r} must match "
+                    "[a-z_][a-z0-9_]*",
+                )
+
+    # -- MTPU103 ----------------------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self._swallows_broadly(node.type) and _only_pass(node.body):
+            caught = (
+                "bare except" if node.type is None
+                else f"except {_dotted(node.type) or '...'}"
+            )
+            self._emit(
+                "MTPU103",
+                node,
+                f"{caught}: pass silently swallows failures; narrow the "
+                "exception, log it, or count it",
+            )
+        self.generic_visit(node)
+
+    @staticmethod
+    def _swallows_broadly(t: "ast.AST | None") -> bool:
+        if t is None:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(_Linter._swallows_broadly(e) for e in t.elts)
+        return _dotted(t) in ("Exception", "BaseException")
+
+
+def lint_source(rel_path: str, text: str) -> "list[Finding]":
+    """Lint one file's source; returns findings BEFORE noqa filtering."""
+    try:
+        tree = ast.parse(text, filename=rel_path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "MTPU100",
+                rel_path,
+                e.lineno or 1,
+                f"syntax error: {e.msg}",
+            )
+        ]
+    linter = _Linter(rel_path)
+    linter.visit(tree)
+    return linter.findings

@@ -1,0 +1,398 @@
+"""Kernel contract checker: abstract-eval every jitted codec entry point.
+
+``jax.eval_shape`` runs the tracer without compiling or executing, so the
+shape/dtype contracts of the TPU codec kernels — including the Pallas
+ones — are checkable on any host, no accelerator required.  For each
+jitted entry point in ``minio_tpu/ops/`` a registered contract states,
+over a grid of (data_shards, parity_shards, shard_len) erasure configs:
+
+* MTPU201 — output dtypes (words stay uint32, byte shards stay uint8,
+  verify masks are bool);
+* MTPU202 — output shard shapes (parity rows = m, digest width = 8, ...);
+* MTPU203 — encode→reconstruct shape round-trips: encoding (k, L) data
+  and reconstructing after dropping all parity-count-many shards must
+  yield (k, L) back, in both the byte and the packed-word domain;
+* MTPU204 — a jitted entry point with NO registered contract.  The
+  registry is closed over module introspection, so adding a kernel
+  without a contract fails the gate rather than silently shrinking
+  coverage.
+
+Findings anchor at the entry point's ``def`` line and name the offending
+config, e.g. ``(data_shards=8, parity_shards=4, shard_len=256)``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .findings import Finding
+
+# (data_shards, parity_shards, shard_len_bytes); shard_len % 32 == 0
+# (words-per-shard multiple of 8, the encode_and_hash_words floor).
+CONFIG_GRID = [
+    (2, 1, 64),
+    (4, 2, 128),
+    (8, 4, 256),
+    (16, 4, 512),
+]
+
+# encode_hash_fused tiles at rs_pallas._TW uint32 words (16 KiB shards);
+# keep this grid small — abstract eval of the Pallas kernel still traces
+# the full XOR chain.
+FUSED_GRID = [
+    (2, 1, 16384),
+    (4, 2, 16384),
+    (8, 4, 16384),
+]
+
+_BATCH = 3  # leading batch dim for the batched kernels
+
+
+def _ops_modules():
+    from minio_tpu.ops import codec_step, hash as phash, rs, rs_pallas
+
+    return {
+        "rs": rs,
+        "rs_pallas": rs_pallas,
+        "codec_step": codec_step,
+        "hash": phash,
+    }
+
+
+def is_jitted(obj) -> bool:
+    """True for jax.jit-wrapped callables (PjitFunction and kin)."""
+    return (
+        callable(obj)
+        and hasattr(obj, "eval_shape")
+        and hasattr(obj, "lower")
+        and hasattr(obj, "__wrapped__")
+    )
+
+
+def jit_entry_points() -> "dict[tuple[str, str], object]":
+    """(module_short_name, attr_name) -> jitted callable, by introspection.
+
+    This is the ground truth the MTPU204 coverage check (and the tier-1
+    introspection test) compare the contract registry against.
+    """
+    out = {}
+    for mod_name, mod in _ops_modules().items():
+        for attr, val in sorted(vars(mod).items()):
+            if is_jitted(val):
+                out[(mod_name, attr)] = val
+    return out
+
+
+def _anchor(fn, default_path: str) -> "tuple[str, int]":
+    """Repo-relative path + def line of a jitted callable."""
+    code = getattr(getattr(fn, "__wrapped__", fn), "__code__", None)
+    if code is None:
+        return default_path, 1
+    path = code.co_filename
+    marker = os.sep + "minio_tpu" + os.sep
+    if marker in path:
+        path = "minio_tpu" + os.sep + path.split(marker, 1)[1]
+    return path.replace(os.sep, "/"), code.co_firstlineno
+
+
+class _ContractContext:
+    """Collects findings for one entry point, tagging the config."""
+
+    def __init__(self, findings, fn, default_path):
+        self.findings = findings
+        self.path, self.line = _anchor(fn, default_path)
+        self.config = ""
+
+    def expect(self, rule: str, got, want, what: str) -> None:
+        if got != want:
+            self.findings.append(
+                Finding(
+                    rule,
+                    self.path,
+                    self.line,
+                    f"{what}: got {got}, want {want} at {self.config}",
+                )
+            )
+
+    def shape(self, got, want, what: str) -> None:
+        self.expect("MTPU202", tuple(got.shape), tuple(want), what + " shape")
+
+    def dtype(self, got, want, what: str) -> None:
+        self.expect("MTPU201", str(got.dtype), str(want), what + " dtype")
+
+    def fail(self, exc: BaseException) -> None:
+        self.findings.append(
+            Finding(
+                "MTPU202",
+                self.path,
+                self.line,
+                f"abstract eval raised {type(exc).__name__}: {exc} "
+                f"at {self.config}",
+            )
+        )
+
+
+def run() -> "list[Finding]":
+    """Check every registered contract; returns findings (empty = green)."""
+    import jax
+    import jax.numpy as jnp
+
+    from minio_tpu.ops import codec_step, gf, rs, rs_pallas
+
+    findings: "list[Finding]" = []
+    S = jax.ShapeDtypeStruct
+    u8, u32 = jnp.uint8, jnp.uint32
+    reps = S((), jnp.int32)  # dynamic trip count of the bench probes
+
+    def ctx(fn, default_path):
+        return _ContractContext(findings, fn, default_path)
+
+    def cfg_str(k, m, L):
+        return f"(data_shards={k}, parity_shards={m}, shard_len={L})"
+
+    checked: "set[tuple[str, str]]" = set()
+
+    def covers(mod, name):
+        checked.add((mod, name))
+
+    # ---- rs.py ----------------------------------------------------------
+
+    covers("rs", "_encode_jit")
+    c = ctx(rs._encode_jit, "minio_tpu/ops/rs.py")
+    for k, m, L in CONFIG_GRID:
+        c.config = cfg_str(k, m, L)
+        try:
+            out = rs._encode_jit.eval_shape(S((k, L), u8), k, m)
+            c.shape(out, (m, L), "parity")
+            c.dtype(out, "uint8", "parity")
+        except Exception as e:  # pragma: no cover - defensive
+            c.fail(e)
+
+    covers("rs", "_reconstruct_jit")
+    c = ctx(rs._reconstruct_jit, "minio_tpu/ops/rs.py")
+    for k, m, L in CONFIG_GRID:
+        n = k + m
+        c.config = cfg_str(k, m, L)
+        try:
+            out = rs._reconstruct_jit.eval_shape(
+                S((n, L), u8), S((n,), u8), S((k, k), u8), k, m, True
+            )
+            c.shape(out, (n, L), "rebuilt (want_parity)")
+            c.dtype(out, "uint8", "rebuilt")
+            out = rs._reconstruct_jit.eval_shape(
+                S((n, L), u8), S((n,), u8), S((k, k), u8), k, m, False
+            )
+            c.shape(out, (k, L), "rebuilt (data only)")
+        except Exception as e:
+            c.fail(e)
+
+    covers("rs", "_reconstruct_static_jit")
+    c = ctx(rs._reconstruct_static_jit, "minio_tpu/ops/rs.py")
+    for k, m, L in CONFIG_GRID:
+        n = k + m
+        # worst admissible erasure: all m losses fall on data shards
+        present = (False,) * m + (True,) * (n - m)
+        c.config = cfg_str(k, m, L)
+        try:
+            out = rs._reconstruct_static_jit.eval_shape(
+                S((n, L), u8), present, k, m, True
+            )
+            c.shape(out, (n, L), "rebuilt (want_parity)")
+            c.dtype(out, "uint8", "rebuilt")
+            # MTPU203: encode -> reconstruct round-trip in the byte domain
+            parity = rs._encode_jit.eval_shape(S((k, L), u8), k, m)
+            data_only = rs._reconstruct_static_jit.eval_shape(
+                S((k + parity.shape[0], L), parity.dtype),
+                present,
+                k,
+                m,
+                False,
+            )
+            c.expect(
+                "MTPU203",
+                (tuple(data_only.shape), str(data_only.dtype)),
+                ((k, L), "uint8"),
+                "encode->reconstruct round-trip (bytes)",
+            )
+        except Exception as e:
+            c.fail(e)
+
+    # ---- codec_step.py --------------------------------------------------
+
+    covers("codec_step", "encode_and_hash_words")
+    c = ctx(codec_step.encode_and_hash_words, "minio_tpu/ops/codec_step.py")
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        c.config = cfg_str(k, m, L)
+        try:
+            parity, digests = codec_step.encode_and_hash_words.eval_shape(
+                S((_BATCH, k, w), u32), m, L
+            )
+            c.shape(parity, (_BATCH, m, w), "parity")
+            c.dtype(parity, "uint32", "parity")
+            c.shape(digests, (_BATCH, n, 8), "digests")
+            c.dtype(digests, "uint32", "digests")
+        except Exception as e:
+            c.fail(e)
+
+    covers("codec_step", "verify_hashes_words")
+    c = ctx(codec_step.verify_hashes_words, "minio_tpu/ops/codec_step.py")
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        c.config = cfg_str(k, m, L)
+        try:
+            ok = codec_step.verify_hashes_words.eval_shape(
+                S((_BATCH, n, w), u32), S((_BATCH, n, 8), u32), L
+            )
+            c.shape(ok, (_BATCH, n), "ok mask")
+            c.dtype(ok, "bool", "ok mask")
+        except Exception as e:
+            c.fail(e)
+
+    covers("codec_step", "reconstruct_words_batch")
+    c = ctx(codec_step.reconstruct_words_batch, "minio_tpu/ops/codec_step.py")
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        present = (False,) * m + (True,) * (n - m)
+        c.config = cfg_str(k, m, L)
+        try:
+            dw = codec_step.reconstruct_words_batch.eval_shape(
+                S((_BATCH, n, w), u32), present, k, m
+            )
+            c.shape(dw, (_BATCH, k, w), "data words")
+            c.dtype(dw, "uint32", "data words")
+            # MTPU203: word-domain round-trip — encode a batch, drop m
+            # shards, reconstruct; shapes must close.
+            parity, _ = codec_step.encode_and_hash_words.eval_shape(
+                S((_BATCH, k, w), u32), m, L
+            )
+            rt = codec_step.reconstruct_words_batch.eval_shape(
+                S((_BATCH, k + parity.shape[1], w), parity.dtype),
+                present,
+                k,
+                m,
+            )
+            c.expect(
+                "MTPU203",
+                (tuple(rt.shape), str(rt.dtype)),
+                ((_BATCH, k, w), "uint32"),
+                "encode->reconstruct round-trip (words)",
+            )
+        except Exception as e:
+            c.fail(e)
+
+    for name in (
+        "encode_throughput_probe",
+        "reconstruct_throughput_probe",
+        "verify_throughput_probe",
+    ):
+        covers("codec_step", name)
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        present = (False,) * m + (True,) * (n - m)
+        probes = [
+            (
+                codec_step.encode_throughput_probe,
+                (S((_BATCH, k, w), u32), m, L, reps),
+            ),
+            (
+                codec_step.reconstruct_throughput_probe,
+                (S((_BATCH, n, w), u32), present, k, m, reps),
+            ),
+            (
+                codec_step.verify_throughput_probe,
+                (S((_BATCH, n, w), u32), S((_BATCH, n, 8), u32), L, reps),
+            ),
+        ]
+        for fn, args in probes:
+            c = ctx(fn, "minio_tpu/ops/codec_step.py")
+            c.config = cfg_str(k, m, L)
+            try:
+                sample, acc = fn.eval_shape(*args)
+                c.shape(sample, (8,), "probe checksum sample")
+                c.dtype(sample, "uint32", "probe checksum sample")
+                c.shape(acc, (), "probe accumulator")
+                c.dtype(acc, "uint32", "probe accumulator")
+            except Exception as e:
+                c.fail(e)
+
+    # ---- rs_pallas.py ---------------------------------------------------
+
+    covers("rs_pallas", "_matmul_words_jit")
+    c = ctx(rs_pallas._matmul_words_jit, "minio_tpu/ops/rs_pallas.py")
+    for k, m, L in CONFIG_GRID:
+        w = L // 4
+        key = gf.parity_matrix(k, m).tobytes()
+        c.config = cfg_str(k, m, L)
+        try:
+            out = rs_pallas._matmul_words_jit.eval_shape(
+                S((k, w), u32), key, m, k, True
+            )
+            c.shape(out, (m, w), "pallas parity words")
+            c.dtype(out, "uint32", "pallas parity words")
+        except Exception as e:
+            c.fail(e)
+
+    covers("rs_pallas", "encode_hash_fused")
+    c = ctx(rs_pallas.encode_hash_fused, "minio_tpu/ops/rs_pallas.py")
+    for k, m, L in FUSED_GRID:
+        w, n = L // 4, k + m
+        c.config = cfg_str(k, m, L)
+        try:
+            parity, hacc = rs_pallas.encode_hash_fused.eval_shape(
+                S((_BATCH, k, w), u32), m, True
+            )
+            c.shape(parity, (_BATCH, m, w), "fused parity")
+            c.dtype(parity, "uint32", "fused parity")
+            c.shape(hacc, (_BATCH, n, 8), "fused hash partials")
+            c.dtype(hacc, "uint32", "fused hash partials")
+        except Exception as e:
+            c.fail(e)
+
+    covers("rs_pallas", "_mxu_matmul_jit")
+    c = ctx(rs_pallas._mxu_matmul_jit, "minio_tpu/ops/rs_pallas.py")
+    for k, m, L in CONFIG_GRID:
+        key = gf.parity_matrix(k, m).tobytes()
+        c.config = cfg_str(k, m, L)
+        try:
+            out = rs_pallas._mxu_matmul_jit.eval_shape(
+                S((k, L), u8), key, m, k, True
+            )
+            c.shape(out, (m, L), "mxu parity bytes")
+            c.dtype(out, "uint8", "mxu parity bytes")
+        except Exception as e:
+            c.fail(e)
+
+    # ---- coverage closure (MTPU204) -------------------------------------
+
+    for (mod, name), fn in jit_entry_points().items():
+        if (mod, name) not in checked:
+            path, line = _anchor(fn, f"minio_tpu/ops/{mod}.py")
+            findings.append(
+                Finding(
+                    "MTPU204",
+                    path,
+                    line,
+                    f"jitted entry point {mod}.{name} has no registered "
+                    "kernel contract; add a check in "
+                    "minio_tpu/analysis/kernel_contracts.py",
+                )
+            )
+
+    return findings
+
+
+def covered_entry_points() -> "set[tuple[str, str]]":
+    """The (module, name) pairs the contract run exercises.
+
+    Derived by running the checker against the live registry: everything
+    introspection finds minus whatever MTPU204 flags.
+    """
+    flagged = {
+        f.message.split(" ")[3] for f in run() if f.rule == "MTPU204"
+    }
+    return {
+        key
+        for key in jit_entry_points()
+        if f"{key[0]}.{key[1]}" not in flagged
+    }

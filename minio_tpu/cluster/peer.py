@@ -38,6 +38,10 @@ import msgpack
 
 from ..utils import jwt
 
+from ..utils.log import kv, logger
+
+_log = logger("peer")
+
 PREFIX = "/minio-tpu/peer/v1"
 _TOKEN_TTL_S = 900
 VERSION = "minio-tpu/1"  # bumped on wire-format changes
@@ -147,8 +151,8 @@ class PeerRESTServer:
                 zones = si.get("zones", [si])
                 info["drives_online"] = sum(z.get("online", 0) for z in zones)
                 info["drives"] = sum(z.get("disks", 0) for z in zones)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("server-info drive count probe failed", extra=kv(err=str(exc)))
         return info
 
     def _load_bucket_metadata(self, q, body) -> dict:
@@ -656,8 +660,8 @@ class PeerRESTClient:
         if c is not None:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("peer connection close failed", extra=kv(err=str(exc)))
             self._local.conn = None
 
     def call(
@@ -807,8 +811,8 @@ class PeerNotifier:
     def _quiet(fn, client) -> None:
         try:
             fn(client)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:
+            _log.debug("peer fan-out call failed", extra=kv(err=str(exc)))
 
     def bucket_meta_changed(self, bucket: str) -> None:
         self._fanout(lambda c: c.load_bucket_metadata(bucket))

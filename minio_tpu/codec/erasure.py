@@ -29,6 +29,10 @@ import numpy as np
 from . import backend as backend_mod, bitrot, compress
 from .telemetry import KERNEL_STATS
 
+from ..utils.log import kv, logger
+
+_log = logger("codec")
+
 BLOCK_SIZE_V1 = 10 * 1024 * 1024  # reference blockSizeV1
 DEFAULT_BATCH_BLOCKS = 4
 
@@ -186,8 +190,8 @@ class Erasure:
             for handle, _batch in pending or []:
                 try:
                     be.encode_end(handle)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("encode_end cleanup after failed flush", extra=kv(err=str(exc)))
 
     def _encode_begin_batch(self, be, blocks):
         """Kick off the device passes for one batch of blocks; returns
@@ -231,8 +235,8 @@ class Erasure:
                     continue  # already consumed by encode_end
                 try:
                     be.encode_end(item[0])
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("encode_end cleanup on error path", extra=kv(err=str(exc)))
             raise
 
     def _flush_groups(
@@ -380,8 +384,8 @@ class Erasure:
                 fut.cancel()
                 try:
                     fut.result()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("prefetch drain after cancel", extra=kv(err=str(exc)))
             pool.shutdown(wait=True)
 
     def _write_blocks(

@@ -196,8 +196,17 @@ class InstrumentedBackend(CodecBackend):
 
 def instrument(
     backend: CodecBackend, stats: "KernelStats | None" = None
-) -> InstrumentedBackend:
-    """Wrap a concrete backend with kernel telemetry (idempotent)."""
+) -> CodecBackend:
+    """Wrap a concrete backend with kernel telemetry (idempotent).
+
+    MINIO_TPU_NO_INSTRUMENT=1 returns the backend bare — used by
+    `bench.py --no-instrument` to measure the codec without the
+    per-op timing/accounting wrapper in the loop.
+    """
+    import os
+
+    if os.environ.get("MINIO_TPU_NO_INSTRUMENT") == "1":
+        return backend
     if isinstance(backend, InstrumentedBackend):
         return backend
     return InstrumentedBackend(backend, stats)

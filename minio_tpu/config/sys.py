@@ -21,6 +21,10 @@ import json
 import os
 import threading
 
+from ..utils.log import kv, logger
+
+_log = logger("config")
+
 DEFAULT_TARGET = "_"
 CONFIG_PATH = "config/config.json"
 
@@ -305,5 +309,5 @@ class ConfigSys:
 
             try:
                 log.setup(self.get("logger", "level"))
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.warning("logger level re-apply failed", extra=kv(err=str(exc)))

@@ -43,6 +43,10 @@ import time
 from ..ilm import Action, Lifecycle, LifecycleError
 from ..objectlayer.api import META_BUCKET
 
+from ..utils.log import kv, logger
+
+_log = logger("crawler")
+
 USAGE_PATH = "data-usage/usage.json"
 # even "clean" buckets get re-swept this often (bloom false negatives
 # are impossible, but cached usage can rot via out-of-band mutation)
@@ -170,8 +174,8 @@ class DataCrawler:
             self._ol.put_object(
                 META_BUCKET, USAGE_PATH, io.BytesIO(raw), len(raw)
             )
-        except Exception:  # noqa: BLE001 - cache only, next cycle retries
-            pass
+        except Exception as exc:
+            _log.debug("usage cache store failed; next cycle retries", extra=kv(err=str(exc)))
 
     def usage(self) -> DataUsage:
         with self._mu:
@@ -237,8 +241,8 @@ class DataCrawler:
             if self._ensure_event_rules is not None:
                 try:
                     self._ensure_event_rules(bucket)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("event-rule preload failed", extra=kv(err=str(exc)))
             made_marker = dinfo is not None and dinfo.delete_marker
             self._events.send(
                 Event(
@@ -402,8 +406,8 @@ class DataCrawler:
             bm = self._meta.get(bucket)
             versioned = bm.versioning_enabled
             suspended = bm.versioning_suspended
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:
+            _log.debug("bucket versioning lookup failed", extra=kv(err=str(exc)))
         bu = BucketUsage()
         seen = 0
         # latest live versions - accumulated ONLY when a FIFO quota is
@@ -482,8 +486,8 @@ class DataCrawler:
                             if not res.is_truncated:
                                 break
                             marker = res.next_marker
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("replication catch-up sweep failed", extra=kv(err=str(exc)))
                 group = []
                 break
             except Exception:  # noqa: BLE001
@@ -521,8 +525,8 @@ class DataCrawler:
         if res.get("outdated"):
             try:
                 self._heal_hook(bucket, oi.name, oi.version_id)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("heal hook failed for crawled object", extra=kv(err=str(exc)))
 
     def _enforce_fifo_quota(
         self, bucket, bu, latest, versioned, suspended
@@ -593,5 +597,5 @@ class DataCrawler:
         while not self._stop.wait(self._effective_interval()):
             try:
                 self.crawl_once()
-            except Exception:  # noqa: BLE001 - never kill the thread
-                pass
+            except Exception as exc:
+                _log.warning("crawl cycle failed", extra=kv(err=str(exc)))

@@ -31,6 +31,10 @@ import threading
 import time
 import uuid
 
+from ..utils.log import kv, logger
+
+_log = logger("dsync")
+
 ACQUIRE_TIMEOUT_S = 1.0  # DRWMutexAcquireTimeout (drwmutex.go:47)
 REFRESH_INTERVAL_S = 10.0  # holder-side refresh cadence
 EXPIRY_S = 30.0  # server-side entry expiry (3 missed refreshes)
@@ -139,8 +143,8 @@ class Dsync:
         for c in self.lockers:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("locker client close failed", extra=kv(err=str(exc)))
 
     def _refresh_loop(self, locker_index: int) -> None:
         c = self.lockers[locker_index]
@@ -264,8 +268,8 @@ class DRWMutex:
                     lockers[i].runlock(args)
                 else:
                     lockers[i].unlock(args)
-            except Exception:  # noqa: BLE001
-                pass  # entry ages out via expiry
+            except Exception as exc:
+                _log.debug("release failed; entry ages out via expiry", extra=kv(err=str(exc)))
 
         def ask(i: int, c) -> None:
             ok = False
@@ -331,8 +335,8 @@ class DRWMutex:
                     lockers[i].runlock(args)
                 else:
                     lockers[i].unlock(args)
-            except Exception:  # noqa: BLE001
-                pass  # unreachable node: entry ages out
+            except Exception as exc:
+                _log.debug("unlock on unreachable node; entry ages out", extra=kv(err=str(exc)))
 
     def _release(self) -> None:
         if not self._uid:

@@ -17,6 +17,10 @@ import time
 
 from .drwmutex import EXPIRY_S, LockArgs
 
+from ..utils.log import kv, logger
+
+_log = logger("dsync")
+
 
 @dataclasses.dataclass
 class LockEntry:
@@ -228,5 +232,5 @@ class LockMaintenance:
         while not self._stop.wait(self._interval):
             try:
                 self._locker.expire_old(self._expiry)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.warning("lock maintenance sweep failed", extra=kv(err=str(exc)))

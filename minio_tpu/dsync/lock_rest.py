@@ -23,6 +23,10 @@ from ..utils import jwt
 from .drwmutex import LockArgs, NetLocker
 from .local_locker import LocalLocker
 
+from ..utils.log import kv, logger
+
+_log = logger("dsync")
+
 PREFIX = "/minio-tpu/lock/v1"
 _TOKEN_TTL_S = 900
 
@@ -132,8 +136,8 @@ class LockRESTClient(NetLocker):
         if c is not None:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("lock REST connection close failed", extra=kv(err=str(exc)))
             self._local.conn = None
 
     def _call(self, method: str, args: LockArgs) -> bool:

@@ -20,6 +20,10 @@ import time
 from .event import Event
 from .rules import NotificationConfig, RulesMap
 
+from ..utils.log import kv, logger
+
+_log = logger("event")
+
 _QUEUE_MAX = 10_000
 
 
@@ -155,5 +159,5 @@ class EventNotifier:
                 continue
             try:
                 target.send(record)
-            except Exception:  # noqa: BLE001 - at-most-once, drop
-                pass
+            except Exception as exc:
+                _log.debug("event target send failed; at-most-once drop", extra=kv(err=str(exc)))

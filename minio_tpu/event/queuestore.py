@@ -18,6 +18,10 @@ import threading
 import time
 import uuid
 
+from ..utils.log import kv, logger
+
+_log = logger("event")
+
 DEFAULT_LIMIT = 10_000
 RETRY_INTERVAL_S = 5.0
 
@@ -139,8 +143,8 @@ class QueuedTarget:
         while not self._stop.wait(self._interval):
             try:
                 self.replay_once()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.warning("queuestore replay cycle failed", extra=kv(err=str(exc)))
 
     def close(self) -> None:
         self._stop.set()

@@ -23,6 +23,10 @@ import os
 import threading
 import urllib.parse
 
+from ..utils.log import kv, logger
+
+_log = logger("event")
+
 
 class TargetError(Exception):
     pass
@@ -65,8 +69,8 @@ class WebhookTarget:
         if c is not None:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("target connection close failed", extra=kv(err=str(exc)))
             self._local.conn = None
 
     def send(self, record: dict) -> None:

@@ -16,6 +16,10 @@ import threading
 import urllib.parse
 import xml.etree.ElementTree as ET
 
+from ..utils.log import kv, logger
+
+_log = logger("gateway")
+
 
 class UpstreamError(Exception):
     def __init__(self, status: int, code: str, message: str = ""):
@@ -75,8 +79,8 @@ class S3UpstreamClient:
         if c is not None:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("upstream connection close failed", extra=kv(err=str(exc)))
         self._local.conn = None
 
     def request(

@@ -22,6 +22,10 @@ import collections
 import dataclasses
 import threading
 
+from ..utils.log import kv, logger
+
+_log = logger("heal")
+
 
 @dataclasses.dataclass(frozen=True)
 class HealTask:
@@ -217,8 +221,8 @@ class FreshDiskMonitor:
         while not self._stop.wait(self._effective_interval()):
             try:
                 self.scan_once()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.warning("background heal scan failed", extra=kv(err=str(exc)))
 
     def scan_once(self) -> int:
         """One probe pass; returns how many fresh disks were stamped."""

@@ -25,6 +25,10 @@ import time
 from ..objectlayer.api import META_BUCKET, ObjectNotFound
 from .policy import CANNED_POLICIES, Args, Policy, PolicyError
 
+from ..utils.log import kv, logger
+
+_log = logger("iam")
+
 IAM_PREFIX = "config/iam"
 
 # STS AssumeRole duration bounds (sts-handlers.go parseDurationSeconds)
@@ -253,8 +257,8 @@ class IAMSys:
             while not stop.wait(interval_s):
                 try:
                     self.refresh()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.warning("iam refresh failed", extra=kv(err=str(exc)))
 
         t = threading.Thread(target=loop, daemon=True, name="iam-refresh")
         t.stop = stop  # type: ignore[attr-defined]

@@ -48,6 +48,10 @@ from .metadata import (
     shuffle_disks,
 )
 
+from ..utils.log import kv, logger
+
+_log = logger("objectlayer")
+
 SYS_VOL = ".sys"
 MP_DIR = "multipart"
 # S3 minimum size for any part other than the last (globalMinPartSize)
@@ -190,8 +194,8 @@ class MultipartMixin:
                 if w is not None:
                     try:
                         w.close()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("shard writer close failed", extra=kv(err=str(exc)))
             self._cleanup_tmp(disks, tmp_ids)
             raise WriteQuorumError(str(e)) from e
         for w in writers:
@@ -327,8 +331,8 @@ class MultipartMixin:
                 d.delete_file(
                     SYS_VOL, self._mp_path(upload_id), recursive=True
                 )
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("upload dir cleanup failed", extra=kv(err=str(exc)))
 
     def complete_multipart_upload(
         self, bucket, object_name, upload_id, parts: list[CompletePart],
@@ -475,14 +479,14 @@ class MultipartMixin:
                                 SYS_VOL,
                                 f"{self._mp_path(upload_id)}/part.{cp.part_number}",
                             )
-                        except Exception:  # noqa: BLE001
-                            pass
+                        except Exception as exc:
+                            _log.debug("part un-rename during complete rollback failed", extra=kv(err=str(exc)))
                     try:
                         d.delete_file(
                             SYS_VOL, f"tmp/{tmp}", recursive=True
                         )
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("tmp cleanup during complete rollback failed", extra=kv(err=str(exc)))
                 raise
             if old_data_dir and old_data_dir != data_dir:
                 for d in disks:
@@ -494,8 +498,8 @@ class MultipartMixin:
                             f"{object_name}/{old_data_dir}",
                             recursive=True,
                         )
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("replaced data dir cleanup failed", extra=kv(err=str(exc)))
         # drop the upload dir
         for d in self._online_disks():
             if d is None:
@@ -504,8 +508,8 @@ class MultipartMixin:
                 d.delete_file(
                     SYS_VOL, self._mp_path(upload_id), recursive=True
                 )
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("upload dir cleanup failed", extra=kv(err=str(exc)))
         return ObjectInfo(
             bucket=bucket,
             name=object_name,

@@ -52,6 +52,10 @@ SYS_VOL = ".sys"
 
 from .erasure_multipart import MultipartMixin
 
+from ..utils.log import kv, logger
+
+_log = logger("objectlayer")
+
 
 class ErasureObjects(MultipartMixin, ObjectLayer):
     """One erasure set over ``disks`` (offline entries are None)."""
@@ -292,8 +296,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 if w is not None:
                     try:
                         w.close()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("shard writer close failed", extra=kv(err=str(exc)))
             self._cleanup_tmp(disks, tmp_ids)
             raise WriteQuorumError(str(e)) from e
         for w in writers:
@@ -363,8 +367,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         ):
             try:
                 self.heal_hook(bucket, object_name)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("partial-write heal hook failed", extra=kv(err=str(exc)))
         # overwrite cleanup: drop the replaced data dir (best effort)
         if old_data_dir and old_data_dir != data_dir:
             for d in disks:
@@ -376,8 +380,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         f"{object_name}/{old_data_dir}",
                         recursive=True,
                     )
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("replaced data dir cleanup failed", extra=kv(err=str(exc)))
         return ObjectInfo(
             bucket=bucket,
             name=object_name,
@@ -395,8 +399,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 continue
             try:
                 d.delete_file(SYS_VOL, f"tmp/{tmp_ids[i]}", recursive=True)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("tmp staging cleanup failed", extra=kv(err=str(exc)))
 
     # ------------------------------------------------------------------
     # get (erasure-object.go:141-331)
@@ -725,8 +729,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         if r is not None:
                             try:
                                 r.close()
-                            except Exception:  # noqa: BLE001
-                                pass
+                            except Exception as exc:
+                                _log.debug("shard reader close failed", extra=kv(err=str(exc)))
                 heal_required = heal_required or healed
                 if sink is not writer:
                     sink.finish()
@@ -740,8 +744,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 if self.heal_hook is not None:
                     try:
                         self.heal_hook(bucket, object_name)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("deep-heal hook failed", extra=kv(err=str(exc)))
             return info
 
     def _part_readers(
@@ -848,8 +852,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         f"{object_name}/{old_null_dir}",
                         recursive=True,
                     )
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("null-version data dir cleanup failed", extra=kv(err=str(exc)))
         return ObjectInfo(
             bucket=bucket,
             name=object_name,
@@ -1148,8 +1152,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     result["healed"].append(i)
                 except serrors.VolumeExists:
                     result["healed"].append(i)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("bucket heal make_vol failed", extra=kv(err=str(exc)))
             return result
 
     def probe_object_health(
@@ -1240,8 +1244,8 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             for i in outdated:
                 try:
                     disks[i].make_vol(SYS_VOL)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("staging vol re-create failed on wiped disk", extra=kv(err=str(exc)))
             for part in fi.parts:
                 readers = []
                 for i, d in enumerate(disks):

@@ -16,6 +16,10 @@ import uuid
 
 from ..storage import errors as serrors
 
+from ..utils.log import kv, logger
+
+_log = logger("objectlayer")
+
 FORMAT_FILE = "format.json"
 FORMAT_BACKEND = "erasure-tpu"
 DISTRIBUTION_ALGO = "CRCMOD"
@@ -79,8 +83,8 @@ def read_format(disk) -> "FormatErasure | None":
 def write_format(disk, fmt: FormatErasure) -> None:
     try:
         disk.make_vol(".sys")  # a wiped drive lost its staging volume
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception as exc:
+        _log.debug("staging vol re-create failed", extra=kv(err=str(exc)))
     disk.write_all(".sys", FORMAT_FILE, fmt.to_bytes())
     disk.set_disk_id(fmt.this)
 

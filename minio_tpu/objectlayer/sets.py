@@ -16,6 +16,10 @@ from . import api
 from .api import ListObjectsInfo, ObjectLayer
 from .erasure_object import ErasureObjects
 
+from ..utils.log import kv, logger
+
+_log = logger("objectlayer")
+
 
 def crc_hash_mod(key: str, cardinality: int) -> int:
     """Set index for an object key (crcHashMod, erasure-sets.go:576)."""
@@ -77,8 +81,8 @@ class ErasureSets(ObjectLayer):
                 for s in made:  # undo partial creation (undoMakeBucket)
                     try:
                         s._delete_bucket(bucket, force=True)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("undo bucket create failed", extra=kv(err=str(exc)))
                 raise
 
     def get_bucket_info(self, bucket: str):

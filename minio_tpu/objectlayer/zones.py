@@ -17,6 +17,10 @@ from .api import ListObjectsInfo, ObjectLayer
 from .sets import ErasureSets, merge_list_results
 from ..crawler.updatetracker import object_path_updated
 
+from ..utils.log import kv, logger
+
+_log = logger("objectlayer")
+
 # Stop placing new objects in a zone once it is this full
 # (diskFillFraction, erasure-zones.go:37).
 _DISK_FILL_FRACTION = 0.95
@@ -50,8 +54,8 @@ class ErasureZones(ObjectLayer):
                     di = d.disk_info()
                     free += di.free
                     total += di.total
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("disk_info probe failed", extra=kv(err=str(exc)))
         return free, total
 
     def _usage_snapshot(self) -> "list[tuple[int, int]]":
@@ -110,8 +114,8 @@ class ErasureZones(ObjectLayer):
             try:
                 z.get_object_info(bucket, object_name)
                 hits[i] = True
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("zone object probe failed", extra=kv(err=str(exc)))
 
         threads = [
             threading.Thread(
@@ -174,8 +178,8 @@ class ErasureZones(ObjectLayer):
                 for z in made:
                     try:
                         z.delete_bucket(bucket, force=True)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("undo bucket create failed", extra=kv(err=str(exc)))
                 raise
 
     def get_bucket_info(self, bucket: str):

@@ -20,6 +20,10 @@ import urllib.parse
 
 from .config import ReplicationConfig, ReplicationError
 
+from ..utils.log import kv, logger
+
+_log = logger("replication")
+
 META_REPLICATION_STATUS = "x-amz-replication-status"
 
 # object metadata that must not be copied onto the destination object
@@ -300,6 +304,6 @@ class ReplicationPool:
                 bucket, key, {META_REPLICATION_STATUS: status},
                 info.version_id,
             )
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:
+            _log.debug("replication status meta update failed", extra=kv(err=str(exc)))
 

@@ -17,6 +17,10 @@ from ..iam.policy import Policy, PolicyError
 from ..iam.sys import IAMError, PolicyNotFound, UserNotFound
 from .s3errors import S3Error
 
+from ..utils.log import kv, logger
+
+_log = logger("admin")
+
 # guards lazy creation of the per-server heal-sequence registry
 _heal_state_lock = threading.Lock()
 
@@ -83,8 +87,8 @@ class AdminAPI:
                             retry=False,
                         )
                         signalled.append(f"{c.host}:{c.port}")
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("peer signal failed", extra=kv(err=str(exc)))
             self._signal_self(action)
             return 200, _json(
                 {"action": action, "peers_signalled": signalled}
@@ -129,8 +133,8 @@ class AdminAPI:
                     try:
                         c.call("startprofiling", {"type": kind})
                         started.append(f"{c.host}:{c.port}")
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("peer profiling start failed", extra=kv(err=str(exc)))
             return 200, _json({"started": started, "type": kind})
         if route == ("GET", "profiling/download"):
             import base64
@@ -442,8 +446,8 @@ class AdminAPI:
                 finally:
                     try:
                         d.delete_file(".sys", path)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as exc:
+                        _log.debug("obd probe file cleanup failed", extra=kv(err=str(exc)))
                 entry["write_mibps"] = round(1 / max(t1 - t0, 1e-9), 1)
                 entry["read_mibps"] = round(1 / max(t2 - t1, 1e-9), 1)
                 entry["latency_ms"] = round((t1 - t0) * 1e3, 2)
@@ -456,8 +460,8 @@ class AdminAPI:
             if callable(stats_fn):
                 try:
                     entry["api_stats"] = stats_fn()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("disk api_stats read failed", extra=kv(err=str(exc)))
             return entry
 
         local = [

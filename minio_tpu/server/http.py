@@ -42,6 +42,10 @@ from .auth import (
 )
 from .s3errors import S3Error
 
+from ..utils.log import kv, logger
+
+_log = logger("http")
+
 MAX_IN_MEMORY_BODY = 1 << 30  # buffered-body cap (XML configs, POST forms)
 MAX_OBJECT_SIZE = 5 << 40  # globalMaxObjectSize (cmd/globals.go)
 # internode requests are metadata or bounded shard flushes (4 MiB); a
@@ -259,8 +263,8 @@ class S3Server:
             return
         try:
             self.events.load_bucket_config(bucket, raw)
-        except Exception:  # noqa: BLE001 - bad persisted doc: no rules
-            pass
+        except Exception as exc:
+            _log.debug("bad persisted notification doc: no rules loaded", extra=kv(err=str(exc)))
         self._event_rules_loaded.add(bucket)
 
     def mark_event_rules_loaded(self, bucket: str) -> None:
@@ -355,14 +359,14 @@ class S3Server:
         if repl is not None and hasattr(repl, "stop"):
             try:
                 repl.stop()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("replication pool stop failed", extra=kv(err=str(exc)))
         peer_rest = getattr(self, "peer_rest", None)
         if peer_rest is not None and hasattr(peer_rest, "close"):
             try:
                 peer_rest.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("peer REST close failed", extra=kv(err=str(exc)))
         # detach the console ring from the shared package logger: a
         # process constructing several servers (tests, embedders) must
         # not accumulate one live handler per dead server
@@ -1365,9 +1369,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.s3.bucket_dns is not None:
             try:
                 self.s3.bucket_dns.unregister(bucket)
-            except Exception:  # noqa: BLE001
-                pass  # stale record; the next create collides and
-                # the operator clears it (reference logs the same)
+            except Exception as exc:
+                _log.debug("bucket DNS unregister failed; stale record", extra=kv(err=str(exc)))
         self.s3.bucket_meta.delete(bucket)
         # a recreated bucket must not inherit the old rules
         self.s3.events.remove_bucket(bucket)
@@ -1461,8 +1464,8 @@ class _Handler(BaseHTTPRequestHandler):
             if registered:
                 try:
                     client.listen_off(lid)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("remote listen_off failed", extra=kv(err=str(exc)))
 
         for client in getattr(notifier, "clients", []):
             t = _threading.Thread(
@@ -2362,8 +2365,8 @@ class _Handler(BaseHTTPRequestHandler):
         lock_xml = ""
         try:
             lock_xml = self.s3.bucket_meta.get(bucket).object_lock_xml
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:
+            _log.debug("bucket object-lock config read failed", extra=kv(err=str(exc)))
         if lock_meta:
             # explicit lock headers need the bucket to be lock-enabled
             if not lock_xml:

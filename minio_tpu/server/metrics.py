@@ -184,7 +184,9 @@ class Metrics:
             ],
         )
         emit(
-            "miniotpu_s3_request_seconds_count",
+            # counters must not end in _count (reserved for histogram
+            # series); see MTPU104 in minio_tpu/analysis
+            "miniotpu_s3_request_seconds_observations_total",
             "counter",
             "Requests counted toward request_seconds by API",
             [({"api": api}, n) for api, (n, _t) in sorted(lat.items())],

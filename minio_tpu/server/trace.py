@@ -173,6 +173,9 @@ class ConsoleCapture(logging.Handler):
         super().__init__()
         self.node = node
         self.ring = SeqRing()
+        # emit() runs inside the logging machinery, so a failure cannot
+        # itself be logged (infinite recursion); count it instead
+        self.dropped = 0
 
     def emit(self, record: logging.LogRecord) -> None:
         try:
@@ -185,8 +188,8 @@ class ConsoleCapture(logging.Handler):
                     "msg": record.getMessage(),
                 }
             )
-        except Exception:  # noqa: BLE001 - logging must never raise
-            pass
+        except Exception:  # noqa: MTPU103 - logging must never raise
+            self.dropped += 1
 
     def install(self) -> "ConsoleCapture":
         # the framework logger stops propagation once log.setup runs,

@@ -32,6 +32,10 @@ from .api import (
 from .errors import DiskNotFound
 from .meta import FileInfo, XLMeta
 
+from ..utils.log import kv, logger
+
+_log = logger("storage")
+
 _RECONNECT_S = 3.0  # defaultRetryUnit-ish probe backoff
 _TOKEN_TTL_S = 900
 
@@ -106,8 +110,8 @@ class RemoteShardWriter(ShardWriter):
             if conn is not None:
                 try:
                     conn.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:
+                    _log.debug("storage REST connection close failed", extra=kv(err=str(exc)))
 
     def _raise_err(self) -> None:
         # shard-writer callers tolerate OSError (quorum accounting);
@@ -237,8 +241,8 @@ class StorageRESTClient(StorageAPI):
         if c is not None:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:
+                _log.debug("storage REST connection close failed", extra=kv(err=str(exc)))
             self._local.conn = None
 
     def _call(

@@ -1,0 +1,35 @@
+"""Fixture: host-device syncs inside jit-traced code (MTPU101).
+
+Each offending line carries a ``# VIOLATION: MTPU###`` marker; the test
+derives the expected (rule, line) set from these markers.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def sync_block(x):
+    y = (x + 1).block_until_ready()  # VIOLATION: MTPU101
+    return y
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def sync_item(x, n: int):
+    s = jnp.sum(x).item()  # VIOLATION: MTPU101
+    return s + n
+
+
+@jax.jit
+def sync_device_get(x):
+    host = jax.device_get(x)  # VIOLATION: MTPU101
+    return host
+
+
+@jax.jit
+def sync_asarray(x):
+    arr = np.asarray(x)  # VIOLATION: MTPU101
+    return arr

@@ -1,0 +1,20 @@
+"""Fixture: retrace bombs — Python params not routed static (MTPU102)."""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def retrace_int(x, n: int):  # VIOLATION: MTPU102
+    return x * n
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def retrace_partial(x, k: int, name: str):  # VIOLATION: MTPU102
+    return x + k + len(name)
+
+
+@jax.jit
+def retrace_tuple(x, dims: tuple):  # VIOLATION: MTPU102
+    return x.reshape(dims)

@@ -1,0 +1,22 @@
+"""Fixture: silently swallowed failures (MTPU103)."""
+
+
+def swallow_exception(fn):
+    try:
+        fn()
+    except Exception:  # VIOLATION: MTPU103
+        pass
+
+
+def swallow_bare(fn):
+    try:
+        fn()
+    except:  # VIOLATION: MTPU103
+        pass
+
+
+def swallow_base(fn):
+    try:
+        fn()
+    except (ValueError, BaseException):  # VIOLATION: MTPU103
+        ...

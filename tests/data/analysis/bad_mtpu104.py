@@ -1,0 +1,22 @@
+"""Fixture: Prometheus metric-name drift (MTPU104)."""
+
+
+def render(emit, emit_histogram, reqs):
+    emit(  # VIOLATION: MTPU104
+        "s3_requests_total",
+        "counter",
+        "missing miniotpu_ prefix",
+        [({}, reqs)],
+    )
+    emit(  # VIOLATION: MTPU104
+        "miniotpu_s3_requests_count",
+        "counter",
+        "counter not ending in _total",
+        [({}, reqs)],
+    )
+    emit_histogram(  # VIOLATION: MTPU104
+        "miniotpu_request_seconds_bucket",
+        "histogram family must not use a reserved suffix",
+        {},
+        "api",
+    )

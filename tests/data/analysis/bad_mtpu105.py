@@ -1,0 +1,13 @@
+"""Fixture: Prometheus label-key hygiene (MTPU105)."""
+
+
+def render(emit, reqs):
+    emit(
+        "miniotpu_s3_requests_total",
+        "counter",
+        "bad label keys",
+        [
+            ({"Api": "GetObject"}, reqs),  # VIOLATION: MTPU105
+            ({"http-code": "200"}, reqs),  # VIOLATION: MTPU105
+        ],
+    )

@@ -1,0 +1,24 @@
+"""Fixture: the clean twins of bad_mtpu101.py — no host syncs in jit."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def no_sync(x):
+    return x + 1
+
+
+def host_boundary(x):
+    # not jit-traced: syncing at the host boundary is the point
+    return np.asarray(jax.device_get(x))
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def static_materialize(x, shape: tuple):
+    # np.* on a STATIC param happens at trace time - legitimate
+    mask = np.asarray(shape)
+    return x + jnp.asarray(mask)

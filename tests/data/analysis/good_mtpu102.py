@@ -1,0 +1,22 @@
+"""Fixture: clean twins of bad_mtpu102.py."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def static_int(x, n: int):
+    return x * n
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_by_num(x, n: int):
+    return x * n
+
+
+@jax.jit
+def dynamic_trip_count(x, reps):
+    # unannotated param: deliberately dynamic (fori_loop trip counts in
+    # the bench probes) - must NOT be flagged
+    return jax.lax.fori_loop(0, reps, lambda _, c: c + x, x)

@@ -1,0 +1,22 @@
+"""Fixture: clean twins of bad_mtpu104.py."""
+
+
+def render(emit, emit_histogram, reqs):
+    emit(
+        "miniotpu_s3_requests_total",
+        "counter",
+        "S3 requests",
+        [({"api": "GetObject"}, reqs)],
+    )
+    emit(
+        "miniotpu_capacity_bytes",
+        "gauge",
+        "gauges need no _total suffix",
+        [({}, reqs)],
+    )
+    emit_histogram(
+        "miniotpu_request_seconds",
+        "request wall time",
+        {},
+        "api",
+    )

@@ -1,0 +1,13 @@
+"""Fixture: clean twin of bad_mtpu105.py."""
+
+
+def render(emit, reqs):
+    emit(
+        "miniotpu_s3_requests_total",
+        "counter",
+        "good label keys",
+        [
+            ({"api": "GetObject"}, reqs),
+            ({"http_code": "200"}, reqs),
+        ],
+    )

@@ -1,0 +1,8 @@
+"""Fixture: a noqa for a DIFFERENT rule must not suppress (MTPU103)."""
+
+
+def swallow_with_unrelated_noqa(fn):
+    try:
+        fn()
+    except Exception:  # noqa: MTPU101  # VIOLATION: MTPU103
+        pass

@@ -1,0 +1,376 @@
+"""Tier-1 gate for minio_tpu.analysis (ISSUE 2).
+
+Three layers of coverage:
+
+* the tree itself is clean — ``run_lint``/``run_contracts``/``run_locks``
+  return no findings, which is the same check the CLI exit status
+  encodes;
+* every rule has a good/bad fixture pair under tests/data/analysis/,
+  and the bad fixtures assert EXACT (rule, line) sets derived from the
+  ``# VIOLATION: MTPU###`` markers in the fixture source;
+* the kernel-contract registry covers 100% of the jitted entry points
+  in minio_tpu/ops/ (introspection vs registry, so a new kernel without
+  a contract fails here).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from minio_tpu import analysis
+from minio_tpu.analysis import kernel_contracts
+from minio_tpu.analysis.findings import (
+    RULES,
+    Finding,
+    filter_suppressed,
+    noqa_codes_for_line,
+)
+from minio_tpu.analysis.hotpath_lint import lint_source
+from minio_tpu.analysis.lockorder import (
+    LockOrderAuditor,
+    _ThreadingProxy,
+)
+
+FIXTURES = os.path.join(analysis.REPO_ROOT, "tests", "data", "analysis")
+_MARKER_RE = re.compile(r"#\s*VIOLATION:\s*(MTPU\d{3})")
+
+
+def _fixture_lines(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as fh:
+        return fh.read().splitlines()
+
+
+def _lint_fixture(name, *, rel_path=None):
+    """Lint one fixture file, noqa-filtered, as the CLI would."""
+    lines = _fixture_lines(name)
+    rel = rel_path or f"tests/data/analysis/{name}"
+    found = lint_source(rel, "\n".join(lines) + "\n")
+    return filter_suppressed(found, {rel: lines})
+
+
+def _expected_markers(name):
+    """The (rule, line) set declared by # VIOLATION: markers."""
+    out = set()
+    for i, line in enumerate(_fixture_lines(name), start=1):
+        for m in _MARKER_RE.finditer(line):
+            out.add((m.group(1), i))
+    return out
+
+
+# -- the tree is clean --------------------------------------------------
+
+
+def test_tree_lint_clean():
+    """minio_tpu/ carries zero unsuppressed lint findings."""
+    found = analysis.run_lint()
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_lock_builtin_scenario_clean():
+    found = analysis.run_locks()
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+@pytest.fixture(scope="module")
+def contract_findings():
+    """Contracts traced once per module (eval_shape over the grid)."""
+    return analysis.run_contracts()
+
+
+def test_tree_contracts_clean(contract_findings):
+    assert contract_findings == [], "\n".join(
+        f.render() for f in contract_findings
+    )
+
+
+# -- contract registry covers every jitted entry point ------------------
+
+# the entry points the seed tree ships; introspection must find at
+# LEAST these (a rename or deletion shows up as a diff here, a new
+# kernel shows up as MTPU204 in the contract run).
+KNOWN_ENTRY_POINTS = {
+    ("rs", "_encode_jit"),
+    ("rs", "_reconstruct_jit"),
+    ("rs", "_reconstruct_static_jit"),
+    ("rs_pallas", "_matmul_words_jit"),
+    ("rs_pallas", "_mxu_matmul_jit"),
+    ("rs_pallas", "encode_hash_fused"),
+    ("codec_step", "encode_and_hash_words"),
+    ("codec_step", "verify_hashes_words"),
+    ("codec_step", "reconstruct_words_batch"),
+    ("codec_step", "encode_throughput_probe"),
+    ("codec_step", "reconstruct_throughput_probe"),
+    ("codec_step", "verify_throughput_probe"),
+}
+
+
+def test_introspection_finds_the_known_entry_points():
+    eps = set(kernel_contracts.jit_entry_points())
+    assert eps >= KNOWN_ENTRY_POINTS
+    # hash.py intentionally exposes no module-level jitted functions
+    assert not any(mod == "hash" for mod, _ in eps)
+
+
+def test_contract_registry_covers_all_entry_points(contract_findings):
+    """100% coverage: registry == introspection, and the run agrees."""
+    eps = set(kernel_contracts.jit_entry_points())
+    covered = kernel_contracts.covered_entry_points()
+    assert covered >= eps, f"uncovered: {sorted(eps - covered)}"
+    assert [f for f in contract_findings if f.rule == "MTPU204"] == []
+
+
+# -- fixture pairs: exact rule IDs and line numbers ---------------------
+
+BAD_FIXTURES = [
+    "bad_mtpu101.py",
+    "bad_mtpu102.py",
+    "bad_mtpu103.py",
+    "bad_mtpu104.py",
+    "bad_mtpu105.py",
+]
+GOOD_FIXTURES = [
+    "good_mtpu101.py",
+    "good_mtpu102.py",
+    "good_mtpu103.py",
+    "good_mtpu104.py",
+    "good_mtpu105.py",
+]
+
+
+@pytest.mark.parametrize("name", BAD_FIXTURES)
+def test_bad_fixture_exact_findings(name):
+    expected = _expected_markers(name)
+    assert expected, f"{name} declares no VIOLATION markers"
+    got = {(f.rule, f.line) for f in _lint_fixture(name)}
+    assert got == expected
+
+
+@pytest.mark.parametrize("name", GOOD_FIXTURES)
+def test_good_fixture_clean(name):
+    found = _lint_fixture(name)
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_noqa_suppresses_matching_rule():
+    found = _lint_fixture("noqa_suppressed.py")
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_noqa_for_other_rule_does_not_suppress():
+    expected = _expected_markers("noqa_wrong_code.py")
+    got = {(f.rule, f.line) for f in _lint_fixture("noqa_wrong_code.py")}
+    assert got == expected
+
+
+def test_noqa_parsing():
+    assert noqa_codes_for_line("x = 1") is None
+    assert noqa_codes_for_line("x = 1  # noqa") == set()
+    assert noqa_codes_for_line("x  # noqa: MTPU103") == {"MTPU103"}
+    assert noqa_codes_for_line("x  # noqa: MTPU101, MTPU102") == {
+        "MTPU101",
+        "MTPU102",
+    }
+    # a reason string after the code list must not break parsing
+    assert noqa_codes_for_line(
+        "x  # noqa: MTPU103 - logging must never raise"
+    ) == {"MTPU103"}
+
+
+def test_device_module_rules_are_path_scoped():
+    """The same sync outside jit is flagged only under ops//codec/."""
+    src = "def helper(x):\n    return x.block_until_ready()\n"
+    dev = lint_source("minio_tpu/ops/fixture.py", src)
+    assert [(f.rule, f.line) for f in dev] == [("MTPU101", 2)]
+    assert lint_source("minio_tpu/server/fixture.py", src) == []
+    # host_* boundary functions are the sanctioned sync points
+    host = "def host_fetch(x):\n    return x.block_until_ready()\n"
+    assert lint_source("minio_tpu/ops/fixture.py", host) == []
+
+
+def test_syntax_error_becomes_mtpu100():
+    found = lint_source("minio_tpu/ops/broken.py", "def f(:\n")
+    assert [f.rule for f in found] == ["MTPU100"]
+
+
+def test_findings_are_stable_sorted_and_serializable():
+    a = Finding("MTPU103", "b.py", 2, "m")
+    b = Finding("MTPU101", "a.py", 9, "m")
+    c = Finding("MTPU101", "a.py", 3, "m")
+    ordered = sorted([a, b, c], key=Finding.sort_key)
+    assert ordered == [c, b, a]
+    d = a.to_dict()
+    assert d == {
+        "rule": "MTPU103",
+        "path": "b.py",
+        "line": 2,
+        "message": "m",
+    }
+    assert a.render() == "b.py:2: MTPU103 m"
+    assert a.rule in RULES
+
+
+# -- lock-order auditor unit behaviour ----------------------------------
+
+
+def test_lockorder_detects_ab_ba_cycle():
+    aud = LockOrderAuditor()
+    proxy = _ThreadingProxy(aud)
+    a, b = proxy.Lock(), proxy.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rep = aud.report()
+    assert [f.rule for f in rep] == ["MTPU301"]
+    assert "lock-order cycle" in rep[0].message
+
+
+def test_lockorder_consistent_order_is_clean():
+    aud = LockOrderAuditor()
+    proxy = _ThreadingProxy(aud)
+    a, b = proxy.Lock(), proxy.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert aud.cycles() == []
+    assert aud.report() == []
+    # one direction was observed, as an edge, exactly once
+    assert len(aud.edge_labels()) == 1
+
+
+def test_lockorder_rlock_reentry_is_not_a_cycle():
+    aud = LockOrderAuditor()
+    proxy = _ThreadingProxy(aud)
+    r = proxy.RLock()
+    with r:
+        with r:
+            pass
+    assert aud.cycles() == []
+    assert aud.edge_labels() == []
+
+
+def test_lockorder_flags_sleep_under_lock():
+    aud = LockOrderAuditor()
+    proxy = _ThreadingProxy(aud)
+    lk = proxy.Lock()
+    real_sleep = time.sleep
+    with aud.installed():
+        with lk:
+            time.sleep(0)
+    assert time.sleep is real_sleep, "uninstall must restore time.sleep"
+    rep = aud.report()
+    assert [f.rule for f in rep] == ["MTPU302"]
+    assert "time.sleep" in rep[0].message
+
+
+def test_lockorder_sleep_without_lock_is_clean():
+    aud = LockOrderAuditor()
+    with aud.installed():
+        time.sleep(0)
+    assert aud.report() == []
+
+
+def test_lockorder_condition_wait_repushes_held_stack():
+    aud = LockOrderAuditor()
+    proxy = _ThreadingProxy(aud)
+    cond = proxy.Condition()
+    with cond:
+        assert aud.held_count() == 1
+        cond.wait(timeout=0.01)  # releases + re-acquires under audit
+        assert aud.held_count() == 1
+    assert aud.held_count() == 0
+
+
+def test_lockorder_install_restores_module_globals():
+    import threading as real_threading
+
+    from minio_tpu.dsync import local_locker
+
+    aud = LockOrderAuditor(targets=("minio_tpu.dsync.local_locker",))
+    with aud.installed():
+        assert local_locker.threading is not real_threading
+    assert local_locker.threading is real_threading
+
+
+# -- CLI contract -------------------------------------------------------
+
+
+def _run_cli(*argv, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "minio_tpu.analysis", *argv],
+        cwd=analysis.REPO_ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+def test_cli_lint_pass_exits_zero_on_tree():
+    r = _run_cli("--skip", "contracts", "locks")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 finding(s)" in r.stderr
+
+
+def test_cli_exits_nonzero_on_bad_fixture():
+    r = _run_cli(
+        "--paths",
+        "tests/data/analysis/bad_mtpu103.py",
+        "--skip",
+        "contracts",
+        "locks",
+    )
+    assert r.returncode == 1
+    assert "MTPU103" in r.stdout
+    # findings render as path:line: RULE message
+    assert re.search(
+        r"tests/data/analysis/bad_mtpu103\.py:\d+: MTPU103", r.stdout
+    )
+
+
+def test_cli_json_is_machine_readable_and_stable():
+    args = (
+        "--json",
+        "--paths",
+        "tests/data/analysis/bad_mtpu101.py",
+        "tests/data/analysis/bad_mtpu104.py",
+        "--skip",
+        "contracts",
+        "locks",
+    )
+    r1 = _run_cli(*args)
+    r2 = _run_cli(*args)
+    assert r1.returncode == 1
+    assert r1.stdout == r2.stdout, "JSON output must be deterministic"
+    data = json.loads(r1.stdout)
+    assert data == sorted(
+        data,
+        key=lambda d: (d["path"], d["line"], d["rule"], d["message"]),
+    )
+    assert {d["rule"] for d in data} == {"MTPU101", "MTPU104"}
+    assert set(data[0]) == {"rule", "path", "line", "message"}
+
+
+def test_cli_list_rules():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rule in RULES:
+        assert rule in r.stdout
+
+
+@pytest.mark.slow
+def test_cli_full_run_is_clean():
+    """All three passes through the real CLI (what CI would run)."""
+    r = _run_cli()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 finding(s) [lint, contracts, locks]" in r.stderr

@@ -64,3 +64,55 @@ def test_detector_catches_a_deliberate_leak():
 
 def test_leakcheck_fixture_is_available(leakcheck):
     """Opt-in marker: the fixture resolves and tolerates a clean test."""
+
+
+def test_lockorder_auditor_leaves_no_residue(leakcheck):
+    """The lock-order auditor (minio_tpu.analysis.lockorder) patches
+    module globals, class methods and blocking builtins on install;
+    uninstall must restore every one of them and leave no threads
+    behind — otherwise a single analysis run would contaminate the
+    rest of the suite."""
+    import threading as real_threading
+
+    from minio_tpu.analysis.lockorder import (
+        LockOrderAuditor,
+        run_builtin_scenario,
+    )
+    from minio_tpu.dsync import local_locker, namespace
+
+    real_sleep = time.sleep
+    rw_methods = {
+        name: getattr(namespace._RWLock, name)
+        for name in (
+            "acquire_read",
+            "acquire_write",
+            "release_read",
+            "release_write",
+        )
+    }
+
+    aud = LockOrderAuditor()
+    with aud.installed():
+        assert namespace.threading is not real_threading
+        assert time.sleep is not real_sleep
+        assert (
+            namespace._RWLock.acquire_read
+            is not rw_methods["acquire_read"]
+        )
+        # exercise the patched plane so restoration isn't vacuous
+        ns = namespace.NamespaceLock()
+        with ns.write("leakb", "obj", timeout=5):
+            pass
+
+    assert namespace.threading is real_threading
+    assert local_locker.threading is real_threading
+    assert time.sleep is real_sleep
+    for name, original in rw_methods.items():
+        assert getattr(namespace._RWLock, name) is original
+
+    # the built-in CLI scenario spins 8 worker threads: all must be
+    # joined and every patch restored by the time it returns (the
+    # leakcheck fixture then verifies thread/fd convergence globally)
+    assert run_builtin_scenario() == []
+    assert time.sleep is real_sleep
+    assert namespace.threading is real_threading
