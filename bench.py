@@ -285,8 +285,30 @@ def bench_e2e(
         # XLA compile - that cost belongs to warmup, not the numbers
         fanout(lambda k: put("warm-" + k))
         fanout(lambda k: get("warm-" + k))
+        from minio_tpu.codec.telemetry import KERNEL_STATS
+
+        def _stage_delta(before, after, op):
+            """Per-stage seconds spent between two telemetry
+            snapshots: where the measured fan-out's wall time went
+            (assemble = frame interleave, codec = device passes,
+            disk = shard I/O waits)."""
+            b = {
+                (s["op"], s["stage"]): s["seconds"]
+                for s in before.get("stages", [])
+            }
+            return {
+                s["stage"]: round(
+                    s["seconds"] - b.get((s["op"], s["stage"]), 0.0), 3
+                )
+                for s in after.get("stages", [])
+                if s["op"] == op
+            }
+
+        snap0 = KERNEL_STATS.snapshot()
         put_wall, put_clat = fanout(put)
+        snap1 = KERNEL_STATS.snapshot()
         get_wall, get_clat = fanout(get)
+        snap2 = KERNEL_STATS.snapshot()
         nops = threads * per_thread
 
         def p99(lats):
@@ -314,6 +336,8 @@ def bench_e2e(
             "get_p50_ms_1": round(
                 statistics.median(get_lat) * 1e3, 1
             ),
+            "put_stages_nc": _stage_delta(snap0, snap1, "put"),
+            "get_stages_nc": _stage_delta(snap1, snap2, "get"),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)

@@ -50,6 +50,7 @@ DEFAULT_TARGETS = (
     "minio_tpu.dsync.namespace",
     "minio_tpu.storage.metered",
     "minio_tpu.storage.diskcheck",
+    "minio_tpu.parallel.iopool",
 )
 
 _THIS_FILE = os.path.abspath(__file__)
@@ -513,4 +514,29 @@ def run_builtin_scenario() -> "list[Finding]":
             t.join(timeout=30)
         if errors:
             raise errors[0]
+
+        # the per-disk I/O fan-out plane (parallel/iopool.py): a
+        # private pool so the audited locks are created, exercised and
+        # torn down entirely inside the audit window — queue cv's,
+        # future locks, flusher cv, backpressure waits, quorum waits
+        from minio_tpu.parallel.iopool import IOPool, ShardFlusher
+
+        pool = IOPool(queues=4, depth=2, name_prefix="iopool-audit")
+        try:
+            futs = [
+                pool.submit(f"disk-{i % 6}", (lambda i=i: i * i))
+                for i in range(24)
+            ]
+            for i, f in enumerate(futs):
+                if f.result_or_raise(timeout=30) != i * i:
+                    raise RuntimeError("iopool scenario result mismatch")
+            fl = ShardFlusher(pool, quorum_exc=RuntimeError)
+            jobs = [
+                (s, f"disk-{s}", (lambda s=s: None), 64)
+                for s in range(4)
+            ]
+            fl.flush(jobs, quorum=3)
+            fl.drain()
+        finally:
+            pool.shutdown()
     return aud.report()

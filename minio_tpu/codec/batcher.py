@@ -34,6 +34,7 @@ from .telemetry import KERNEL_STATS
 class _Job:
     __slots__ = (
         "op", "key", "arrays", "result", "error", "done", "created",
+        "client",
     )
 
     def __init__(self, op: str, key: tuple, arrays: tuple):
@@ -44,6 +45,7 @@ class _Job:
         self.error: "BaseException | None" = None
         self.done = threading.Event()
         self.created = time.monotonic()
+        self.client = threading.get_ident()
 
 
 class BatchingBackend(CodecBackend):
@@ -62,8 +64,14 @@ class BatchingBackend(CodecBackend):
         self.max_batch_blocks = max_batch_blocks
         self._cv = threading.Condition()
         self._jobs: list[_Job] = []
-        # clients currently inside a codec call (submitted or about to)
-        self._active = 0
+        # client threads currently inside a codec call (submitted or
+        # about to): thread ident -> outstanding call/handle count.
+        # Distinct CLIENTS is the flush signal — a pipelined stream
+        # holding an un-ended handle while submitting its next batch is
+        # still one client, not two (counting raw handles makes the
+        # "everyone submitted" fast path unreachable and every flush
+        # waits out the full deadline)
+        self._active: "dict[int, int]" = {}
         self._running = True
         self._thread = threading.Thread(
             target=self._loop, name="codec-batcher", daemon=True
@@ -71,6 +79,18 @@ class BatchingBackend(CodecBackend):
         self._thread.start()
 
     # -- client side ------------------------------------------------------
+
+    def _enter(self, client: int) -> None:
+        """cv held: one more outstanding call/handle for ``client``."""
+        self._active[client] = self._active.get(client, 0) + 1
+
+    def _exit(self, client: int) -> None:
+        """cv held: drop one outstanding call/handle for ``client``."""
+        left = self._active.get(client, 0) - 1
+        if left <= 0:
+            self._active.pop(client, None)
+        else:
+            self._active[client] = left
 
     def _submit(self, op: str, key: tuple, arrays: tuple):
         job = _Job(op, key, arrays)
@@ -99,7 +119,7 @@ class BatchingBackend(CodecBackend):
         B, k, L = data.shape
         job = _Job("encode", (k, L, parity_shards), (data,))
         with self._cv:
-            self._active += 1
+            self._enter(job.client)
             self._jobs.append(job)
             self._cv.notify_all()
         return job
@@ -113,32 +133,38 @@ class BatchingBackend(CodecBackend):
             return job.result
         finally:
             with self._cv:
-                self._active -= 1
+                # pair with the SUBMITTING thread's entry: a pipelined
+                # caller may end a handle from a different thread
+                self._exit(job.client)
                 self._cv.notify_all()
 
     def digest(self, shards):
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
         B, n, L = shards.shape
+        client = threading.get_ident()
         with self._cv:
-            self._active += 1
+            self._enter(client)
         try:
             return self._submit("digest", (n, L), (shards,))
         finally:
             with self._cv:
-                self._active -= 1
+                self._exit(client)
+                self._cv.notify_all()
 
     def reconstruct(self, shards, present, data_shards, parity_shards):
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
         B, n, L = shards.shape
         key = (n, L, tuple(bool(b) for b in present), data_shards,
                parity_shards)
+        client = threading.get_ident()
         with self._cv:
-            self._active += 1
+            self._enter(client)
         try:
             return self._submit("reconstruct", key, (shards,))
         finally:
             with self._cv:
-                self._active -= 1
+                self._exit(client)
+                self._cv.notify_all()
 
     def shutdown(self) -> None:
         with self._cv:
@@ -159,8 +185,16 @@ class BatchingBackend(CodecBackend):
             deadline = time.monotonic() + self.deadline_s
             while True:
                 # flush when nobody else could still contribute, when
-                # the batch is big enough, or at the deadline
-                if len(self._jobs) >= self._active:
+                # the batch is big enough, or at the deadline.  The
+                # contribution test compares DISTINCT clients: every
+                # queued job's submitter is guaranteed active, so the
+                # batch is complete exactly when each active client
+                # has at least one job queued (a client pipelining two
+                # begins is one contributor, not two)
+                if (
+                    len({j.client for j in self._jobs})
+                    >= len(self._active)
+                ):
                     break
                 if (
                     sum(j.arrays[0].shape[0] for j in self._jobs)

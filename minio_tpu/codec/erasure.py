@@ -22,13 +22,16 @@ None writer/reader is an offline disk, tolerated down to the quorum.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import threading
+import time
 
 import numpy as np
 
 from . import backend as backend_mod, bitrot, compress
 from .telemetry import KERNEL_STATS
 
+from ..parallel import iopool
 from ..utils.log import kv, logger
 
 _log = logger("codec")
@@ -36,24 +39,35 @@ _log = logger("codec")
 BLOCK_SIZE_V1 = 10 * 1024 * 1024  # reference blockSizeV1
 DEFAULT_BATCH_BLOCKS = 4
 
+# read-ahead jobs carry a fresh sequence key so concurrent GETs spread
+# across pool queues instead of serializing behind one worker
+_RA_SEQ = itertools.count()
 
-def _parallel_map(fn, items: list) -> list:
-    """Run fn over items on one thread each (shard-read fan-out); each
-    item is an independent reader so there is no shared state."""
-    results = [None] * len(items)
+# stage accounting from iopool workers (frame assembly runs on the
+# writer's queue, not the submitting thread)
+_STAGE_LK = threading.Lock()
 
-    def run(idx, it):
-        results[idx] = fn(it)
 
-    threads = [
-        threading.Thread(target=run, args=(idx, it), daemon=True)
-        for idx, it in enumerate(items)
+def _io_key(obj):
+    """Routing key for a writer/reader: the object layer stamps disks
+    with a stable endpoint ``io_key``; untagged test doubles hash by
+    identity (still one ordered queue per instance)."""
+    return getattr(obj, "io_key", None) or ("anon", id(obj))
+
+
+def _fanout_reads(fn, slots: list, readers, nbytes: int) -> list:
+    """Run ``fn(slot)`` for every slot through the shared iopool, one
+    job per shard (replaces the old thread-per-call _parallel_map).
+    ``fn`` must capture its own errors — a reader failure is data
+    (a dead shard), not an exception."""
+    if len(slots) <= 1:
+        return [fn(s) for s in slots]
+    pool = iopool.get_pool()
+    futs = [
+        pool.submit(_io_key(readers[s]), (lambda s=s: fn(s)), nbytes=nbytes)
+        for s in slots
     ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    return results
+    return [f.result_or_raise() for f in futs]
 
 
 class ErasureError(Exception):
@@ -149,6 +163,13 @@ class Erasure:
         k, m = self.data_blocks, self.parity_blocks
         total = 0
         eof = False
+        # quorum-aware shard fan-out: one ordered pool queue per disk,
+        # flush() returns at write_quorum acks, stragglers drain in the
+        # background (parallelWriter, erasure-encode.go:39-70)
+        flusher = iopool.ShardFlusher(
+            iopool.get_pool(), quorum_exc=QuorumError
+        )
+        stages = {"assemble": 0.0, "codec": 0.0, "disk": 0.0}
         # double-buffered pipeline (erasure-encode.go:73-109 overlap,
         # SURVEY stage 8): batch k's H2D + device pass is in flight
         # while batch k-1's shards stream to disk/network; exactly one
@@ -168,11 +189,13 @@ class Erasure:
                     total += len(buf)
                 if not blocks:
                     break
-                started = self._encode_begin_batch(be, blocks)
+                started = self._encode_begin_batch(be, blocks, stages)
+                blocks = None  # scattered into the batch arrays above
                 if pending is not None:
                     try:
                         self._flush_batch(
-                            be, pending, writers, write_quorum
+                            be, pending, writers, write_quorum,
+                            flusher, stages,
                         )
                     finally:
                         pending = started
@@ -180,8 +203,25 @@ class Erasure:
                     pending = started
             if pending is not None:
                 p, pending = pending, None
-                self._flush_batch(be, p, writers, write_quorum)
+                self._flush_batch(
+                    be, p, writers, write_quorum, flusher, stages
+                )
+            # early-acked batches may still have stragglers in flight:
+            # settle them and re-check the quorum over the final disk
+            # liveness picture before declaring the object durable
+            t0 = time.monotonic()
+            for s in flusher.drain():
+                if s < len(writers):
+                    writers[s] = None
+            stages["disk"] += time.monotonic() - t0
+            if flusher.submitted:
+                alive = sum(1 for w in writers if w is not None)
+                if alive < write_quorum:
+                    raise QuorumError(
+                        f"write quorum lost: {alive} < {write_quorum}"
+                    )
             KERNEL_STATS.record_stream("encode", total)
+            KERNEL_STATS.record_stages("put", stages)
             return total
         finally:
             # an error mid-flush must not abandon begun handles: a
@@ -192,8 +232,13 @@ class Erasure:
                     be.encode_end(handle)
                 except Exception as exc:
                     _log.debug("encode_end cleanup after failed flush", extra=kv(err=str(exc)))
+            # nor may background shard writes race the caller closing
+            # its writers: settle the pool before handing back
+            for s in flusher.drain():
+                if s < len(writers):
+                    writers[s] = None
 
-    def _encode_begin_batch(self, be, blocks):
+    def _encode_begin_batch(self, be, blocks, stages):
         """Kick off the device passes for one batch of blocks; returns
         [(handle, batch_array), ...] per uniform-shard-size group."""
         k = self.data_blocks
@@ -208,24 +253,36 @@ class Erasure:
             groups.append((self.shard_size_padded(len(b)), [b]))
         started = []
         for shard_len, group in groups:
+            t0 = time.monotonic()
             batch = np.zeros((len(group), k, shard_len), dtype=np.uint8)
             for bi, block in enumerate(group):
+                # one reshape scatters the whole block across its k
+                # shard rows (the per-shard slice loop was O(k) tiny
+                # copies per block)
                 ss = self.shard_size(len(block))
-                for s in range(k):
-                    chunk = block[s * ss : (s + 1) * ss]
-                    if chunk:
-                        batch[bi, s, : len(chunk)] = np.frombuffer(
-                            chunk, dtype=np.uint8
-                        )
+                a = np.frombuffer(block, dtype=np.uint8)
+                rows, rem = divmod(len(a), ss)
+                if rows:
+                    batch[bi, :rows, :ss] = a[: rows * ss].reshape(
+                        rows, ss
+                    )
+                if rem:
+                    batch[bi, rows, :rem] = a[rows * ss :]
+            stages["assemble"] += time.monotonic() - t0
+            t0 = time.monotonic()
             started.append((be.encode_begin(batch, m), batch))
+            stages["codec"] += time.monotonic() - t0
         return started
 
-    def _flush_batch(self, be, started, writers, write_quorum) -> None:
+    def _flush_batch(
+        self, be, started, writers, write_quorum, flusher, stages
+    ) -> None:
         k, m = self.data_blocks, self.parity_blocks
         n = k + m
         try:
             self._flush_groups(
-                be, started, writers, write_quorum, k, n
+                be, started, writers, write_quorum, k, n,
+                flusher, stages,
             )
         except BaseException:
             # end the groups the failed iteration never reached
@@ -239,31 +296,83 @@ class Erasure:
                     _log.debug("encode_end cleanup on error path", extra=kv(err=str(exc)))
             raise
 
+    @staticmethod
+    def _run_writer(w, dig_s, src, col, ds, stages):
+        """Build the write job for one disk's byte run.  The interleave
+        itself executes ON the iopool worker, and the closure pins only
+        what this disk actually reads — its digest column plus EITHER
+        the data batch OR the parity array — so a straggler generation
+        costs one shared array, never per-disk copies."""
+        def _job():
+            t0 = time.monotonic()
+            shard = src[:, col, :]
+            B = shard.shape[0]
+            run = np.empty((B, ds + shard.shape[1]), dtype=np.uint8)
+            run[:, :ds] = dig_s
+            run[:, ds:] = shard
+            dt = time.monotonic() - t0
+            with _STAGE_LK:
+                stages["assemble"] += dt
+            # hand the writer a view, not a bytes copy: every write
+            # path (file, REST pipe, test shards) copies on its own
+            # terms, so the run is never duplicated wholesale
+            w.write(run.reshape(-1).data)
+        return _job
+
     def _flush_groups(
-        self, be, started, writers, write_quorum, k, n
+        self, be, started, writers, write_quorum, k, n,
+        flusher, stages,
     ) -> None:
+        """Assemble each disk's contiguous byte run for the whole batch
+        with one numpy interleave (digest frames + payload rows) and
+        fan the n runs out through the iopool — ONE buffer per disk per
+        batch, the write twin of the one-ranged-read-per-shard GET."""
+        jobs = []
         for i, (handle, batch) in enumerate(started):
             started[i] = None  # consumed: error path must not re-end
+            t0 = time.monotonic()
             parity, digests = be.encode_end(handle)
-            for bi in range(batch.shape[0]):
-                alive = 0
-                for s in range(n):
-                    w = writers[s] if s < len(writers) else None
-                    if w is None:
-                        continue
-                    payload = (
-                        batch[bi, s] if s < k else parity[bi, s - k]
-                    ).tobytes()
-                    frame = bitrot.digest_to_bytes(digests[bi, s])
-                    try:
-                        w.write(frame + payload)
-                        alive += 1
-                    except OSError:
-                        writers[s] = None
-                if alive < write_quorum:
-                    raise QuorumError(
-                        f"write quorum lost: {alive} < {write_quorum}"
-                    )
+            stages["codec"] += time.monotonic() - t0
+            t0 = time.monotonic()
+            B, shard_len = batch.shape[0], batch.shape[2]
+            ds = bitrot.DIGEST_SIZE
+            # digest words -> 32B frames, all (block, shard) cells at
+            # once; byte layout matches bitrot.digest_to_bytes
+            dig = (
+                np.ascontiguousarray(digests, dtype=np.uint32)
+                .view(np.uint8)
+                .reshape(B, n, ds)
+            )
+            par = np.asarray(parity, dtype=np.uint8)
+            stages["assemble"] += time.monotonic() - t0
+            for s in range(n):
+                w = writers[s] if s < len(writers) else None
+                if w is None:
+                    continue
+                jobs.append((
+                    s,
+                    _io_key(w),
+                    self._run_writer(
+                        w,
+                        dig[:, s, :],
+                        batch if s < k else par,
+                        s if s < k else s - k,
+                        ds,
+                        stages,
+                    ),
+                    B * (ds + shard_len),
+                ))
+        alive = {s for s, _key, _fn, _nb in jobs}
+        if len(alive) < write_quorum:
+            raise QuorumError(
+                f"write quorum lost: {len(alive)} < {write_quorum}"
+            )
+        t0 = time.monotonic()
+        dead = flusher.flush(jobs, write_quorum)
+        stages["disk"] += time.monotonic() - t0
+        for s in dead:
+            if s < len(writers):
+                writers[s] = None
 
     # ---- streaming decode (cmd/erasure-decode.go:211-290) ---------------
 
@@ -284,11 +393,13 @@ class Erasure:
         still allowed reconstruction (errHealRequired semantics,
         erasure-decode.go:165-167).
         """
+        stages = {"assemble": 0.0, "codec": 0.0, "disk": 0.0}
         written, heal_required = self._decode_stream(
             writer, readers, offset, length, total_length,
-            batch_blocks, backend,
+            batch_blocks, backend, stages,
         )
         KERNEL_STATS.record_stream("decode", written)
+        KERNEL_STATS.record_stages("get", stages)
         if heal_required:
             KERNEL_STATS.record_heal_required()
         return written, heal_required
@@ -302,6 +413,7 @@ class Erasure:
         total_length: int,
         batch_blocks: int = DEFAULT_BATCH_BLOCKS,
         backend: "backend_mod.CodecBackend | None" = None,
+        stages: "dict | None" = None,
     ) -> tuple[int, bool]:
         if length == 0:
             return 0, False
@@ -321,18 +433,10 @@ class Erasure:
             bi += len(batch_idx)
         written = 0
         heal_required = False
-        # the read-ahead thread earns its keep when shard reads block
-        # on the network (GIL released, batch k+1's RTTs overlap the
-        # client write of batch k); for all-local page-cache reads on
-        # a busy host it only adds scheduler contention
-        remote = any(
-            r is not None and not getattr(r, "is_local", True)
-            for r in readers
-        )
-        if len(batches) <= 1 or not remote:
+        if len(batches) <= 1:
             for batch_idx in batches:
                 datas, healed = self._decode_blocks(
-                    be, readers, batch_idx, total_length
+                    be, readers, batch_idx, total_length, stages
                 )
                 heal_required = heal_required or healed
                 w, done = self._write_blocks(
@@ -345,33 +449,41 @@ class Erasure:
             return written, heal_required
         # read-ahead pipeline (the GET twin of the encode double
         # buffer): batch k+1's shard reads + verify + reconstruct run
-        # on a worker thread while batch k streams to the client.
+        # on an iopool worker while batch k streams to the client —
+        # now unconditionally: local reads also fan out per disk, so
+        # the prefetch overlaps the decode device pass with the next
+        # group's reads just like encode double-buffers its flush.
         # Exactly one prefetch is in flight, so _decode_blocks never
         # runs concurrently with itself (it mutates `readers`).
-        import concurrent.futures
-
-        pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="decode-readahead"
-        )
+        pool = iopool.get_pool()
         fut = None
         try:
+            # aux band: the prefetch BLOCKS on leaf read futures, so it
+            # must never occupy (or queue behind) a disk queue worker
             fut = pool.submit(
-                self._decode_blocks, be, readers, batches[0],
-                total_length,
+                ("readahead", next(_RA_SEQ)),
+                lambda b=batches[0]: self._decode_blocks(
+                    be, readers, b, total_length, stages
+                ),
+                aux=True,
             )
             for i, batch_idx in enumerate(batches):
-                datas, healed = fut.result()
+                datas, healed = fut.result_or_raise()
                 fut = None
                 heal_required = heal_required or healed
                 if i + 1 < len(batches):
                     fut = pool.submit(
-                        self._decode_blocks, be, readers,
-                        batches[i + 1], total_length,
+                        ("readahead", next(_RA_SEQ)),
+                        lambda b=batches[i + 1]: self._decode_blocks(
+                            be, readers, b, total_length, stages
+                        ),
+                        aux=True,
                     )
                 w, done = self._write_blocks(
                     writer, datas, batch_idx, offset, length,
                     total_length,
                 )
+                datas = None  # release batch k before blocking on k+1
                 written += w
                 if done:
                     return written, heal_required
@@ -381,12 +493,9 @@ class Erasure:
             # leave the prefetch racing the caller's reader close -
             # drain the in-flight read before handing back
             if fut is not None:
-                fut.cancel()
-                try:
-                    fut.result()
-                except Exception as exc:
-                    _log.debug("prefetch drain after cancel", extra=kv(err=str(exc)))
-            pool.shutdown(wait=True)
+                fut.wait()
+                if fut.error is not None:
+                    _log.debug("prefetch drain after early return", extra=kv(err=str(fut.error)))
 
     def _write_blocks(
         self, writer, datas, batch_idx, offset, length, total_length
@@ -414,7 +523,8 @@ class Erasure:
         return written, False
 
     def _decode_blocks(
-        self, be, readers, block_indices: list[int], total_length: int
+        self, be, readers, block_indices: list[int],
+        total_length: int, stages: "dict | None" = None,
     ) -> tuple[list[bytes], bool]:
         """Read + verify + reconstruct a batch of blocks -> raw block bytes.
 
@@ -426,6 +536,8 @@ class Erasure:
         """
         k, m = self.data_blocks, self.parity_blocks
         n = k + m
+        if stages is None:
+            stages = {"assemble": 0.0, "codec": 0.0, "disk": 0.0}
         while len(readers) < n:
             readers.append(None)
         sizes = [
@@ -445,39 +557,57 @@ class Erasure:
             group = block_indices[i:j]
             shard_len = sizes[i]
             shards, ok, g_heal = self._read_group_quorum(
-                be, readers, group, shard_len
+                be, readers, group, shard_len, stages
             )
             heal = heal or g_heal
             # reconstruct per distinct pattern (usually one)
-            datas = np.zeros((len(group), k, shard_len), dtype=np.uint8)
+            t0 = time.monotonic()
             patterns: dict[tuple, list[int]] = {}
             for gi in range(len(group)):
                 pat = tuple(bool(x) for x in ok[gi])
                 patterns.setdefault(pat, []).append(gi)
-            for pat, gis in patterns.items():
-                if all(pat[:k]):
-                    datas[gis] = shards[gis][:, :k]
-                else:
-                    datas[np.asarray(gis)] = be.reconstruct(
-                        shards[np.asarray(gis)], pat, k, m
-                    )
+            if len(patterns) == 1 and all(next(iter(patterns))[:k]):
+                # healthy fast path: every block has its data rows
+                # intact, so stream straight out of the frame buffer -
+                # no (g, k, shard_len) copy, no fancy-index temporaries
+                datas = shards[:, :k, :]
+            else:
+                datas = np.zeros(
+                    (len(group), k, shard_len), dtype=np.uint8
+                )
+                for pat, gis in patterns.items():
+                    if all(pat[:k]):
+                        datas[gis] = shards[gis][:, :k]
+                    else:
+                        datas[np.asarray(gis)] = be.reconstruct(
+                            shards[np.asarray(gis)], pat, k, m
+                        )
+            stages["codec"] += time.monotonic() - t0
+            shards = ok = None  # raw frames die before blocks copy out
+            t0 = time.monotonic()
             for gi, b in enumerate(group):
                 block_len = self._block_len(b, total_length)
                 ss = self.shard_size(block_len)
                 block = datas[gi, :, :ss].reshape(-1)[:block_len]
                 out.append(block.tobytes())
+            datas = None  # only the extracted blocks survive the group
+            stages["assemble"] += time.monotonic() - t0
             i = j
         return out, heal
 
     def _read_group_quorum(
-        self, be, readers, group: list[int], shard_len: int
+        self, be, readers, group: list[int], shard_len: int,
+        stages: "dict | None" = None,
     ):
         """Read shard frames for one equal-size block group until every
         block has >= k intact shards, escalating through the preference
-        order; remote readers are driven concurrently and contiguous
-        frames are fetched in one ranged read per shard (one RTT per
-        shard per batch, the read twin of RemoteShardWriter's pipelined
-        sender threads)."""
+        order; shard reads always fan out per disk through the shared
+        iopool (local disks too — 12 spindles seek concurrently) and
+        contiguous frames are fetched in one ranged read per shard (one
+        RTT per shard per batch, the read twin of the pipelined shard
+        writers)."""
+        if stages is None:
+            stages = {"assemble": 0.0, "codec": 0.0, "disk": 0.0}
         k, m = self.data_blocks, self.parity_blocks
         n = k + m
         g = len(group)
@@ -500,7 +630,9 @@ class Erasure:
             try:
                 if contiguous:
                     base = self.shard_block_offset(group[0])
-                    buf = r.read_at(base, frame * g)
+                    # zero-copy frame slices: one ranged read per
+                    # shard, parsed as views, never re-copied
+                    buf = memoryview(r.read_at(base, frame * g))
                     for gi in range(g):
                         c = buf[gi * frame : (gi + 1) * frame]
                         if len(c) == frame:
@@ -532,12 +664,12 @@ class Erasure:
                     f"read quorum lost: {intact}/{n} shards intact,"
                     f" need {k}"
                 )
-            if len(batch) > 1 and any(
-                not getattr(readers[s], "is_local", True) for s in batch
-            ):
-                results = _parallel_map(read_shard, batch)
-            else:
-                results = [read_shard(s) for s in batch]
+            t0 = time.monotonic()
+            results = _fanout_reads(
+                read_shard, batch, readers, frame * g
+            )
+            stages["disk"] += time.monotonic() - t0
+            t0 = time.monotonic()
             for s, frames in zip(batch, results):
                 for gi, c in enumerate(frames):
                     if c is None:
@@ -550,17 +682,27 @@ class Erasure:
                         c[bitrot.DIGEST_SIZE :], dtype=np.uint8
                     )
                     present[gi, s] = True
+            results = None  # ranged-read buffers die before verify
+            stages["assemble"] += time.monotonic() - t0
             # verify only the shards just read: a healthy GET hashes
             # exactly k columns, and escalation rounds never re-hash
             # already-verified shards
+            t0 = time.monotonic()
             bcols = np.asarray(batch)
-            okb = (
-                be.verify(shards[:, bcols], digests[:, bcols])
-                & present[:, bcols]
-            )
+            if batch == list(range(batch[0], batch[0] + len(batch))):
+                # contiguous columns (the healthy k-data-shard case):
+                # basic slices give verify views, not 4 MiB temporaries
+                sh_cols = shards[:, batch[0] : batch[0] + len(batch)]
+                dg_cols = digests[:, batch[0] : batch[0] + len(batch)]
+            else:
+                sh_cols = shards[:, bcols]
+                dg_cols = digests[:, bcols]
+            okb = be.verify(sh_cols, dg_cols) & present[:, bcols]
+            sh_cols = dg_cols = None
             if (okb != present[:, bcols]).any():
                 heal = True  # bitrot detected somewhere
             ok[:, bcols] = okb
+            stages["codec"] += time.monotonic() - t0
         return shards, ok, heal
 
     # ---- heal (cmd/erasure-lowlevel-heal.go:28-48) ----------------------
@@ -604,15 +746,9 @@ class Erasure:
                 for s in range(n)
                 if s < len(readers) and readers[s] is not None
             ]
-            if len(live) > 1 and any(
-                not getattr(readers[s], "is_local", True)
-                for s in live
-            ):
-                # survivors on remote disks: one RTT, not a serial
-                # walk (the heal twin of the decode fan-out)
-                results = _parallel_map(read_frame, live)
-            else:
-                results = [read_frame(s) for s in live]
+            # survivors read concurrently, one iopool queue per disk
+            # (the heal twin of the decode fan-out)
+            results = _fanout_reads(read_frame, live, readers, frame)
             for s, buf in zip(live, results):
                 if buf is None:
                     continue

@@ -46,6 +46,13 @@ class KernelStats:
         # erasure-layer streams: kind -> [streams, bytes]
         self._streams: "dict[str, list]" = {}
         self._heal_required = 0
+        # per-stream stage breakdown: (op, stage) -> [streams, seconds]
+        # op in {"put","get"}, stage in {"assemble","codec","disk"}
+        self._stages: "dict[tuple[str, str], list]" = {}
+        # iopool fan-out plane: queue -> [jobs, bytes, busy_seconds]
+        self._iopool: "dict[str, list]" = {}
+        self._iopool_depth_hwm = 0
+        self._iopool_slowest_s = 0.0
 
     # -- recording --------------------------------------------------------
 
@@ -76,6 +83,36 @@ class KernelStats:
     def record_heal_required(self) -> None:
         with self._mu:
             self._heal_required += 1
+
+    def record_stages(self, op: str, stages: "dict[str, float]") -> None:
+        """One stream's stage breakdown (assemble / codec / disk)."""
+        with self._mu:
+            for stage, seconds in stages.items():
+                row = self._stages.setdefault((op, stage), [0, 0.0])
+                row[0] += 1
+                row[1] += seconds
+
+    def record_io_job(
+        self, queue: str, nbytes: int, seconds: float, depth: int
+    ) -> None:
+        """One completed iopool job; ``depth`` is the queue's backlog
+        at dequeue (the slowest-disk signal: a healthy disk drains to
+        zero, a straggler's queue stays deep)."""
+        with self._mu:
+            row = self._iopool.setdefault(queue, [0, 0, 0.0])
+            row[0] += 1
+            row[1] += nbytes
+            row[2] += seconds
+            if depth > self._iopool_depth_hwm:
+                self._iopool_depth_hwm = depth
+            if seconds > self._iopool_slowest_s:
+                self._iopool_slowest_s = seconds
+
+    def record_io_depth(self, queue: str, depth: int) -> None:
+        """Queue depth observed at enqueue (high-water mark only)."""
+        with self._mu:
+            if depth > self._iopool_depth_hwm:
+                self._iopool_depth_hwm = depth
 
     # -- reading ----------------------------------------------------------
 
@@ -108,6 +145,34 @@ class KernelStats:
                     )
                 ],
                 "heal_required": self._heal_required,
+                "stages": [
+                    {
+                        "op": op,
+                        "stage": stage,
+                        "streams": n,
+                        "seconds": round(secs, 6),
+                    }
+                    for (op, stage), (n, secs) in sorted(
+                        self._stages.items()
+                    )
+                ],
+                "iopool": {
+                    "queues": [
+                        {
+                            "queue": q,
+                            "jobs": jobs,
+                            "bytes": nbytes,
+                            "busy_seconds": round(busy, 6),
+                        }
+                        for q, (jobs, nbytes, busy) in sorted(
+                            self._iopool.items()
+                        )
+                    ],
+                    "depth_hwm": self._iopool_depth_hwm,
+                    "slowest_job_seconds": round(
+                        self._iopool_slowest_s, 6
+                    ),
+                },
             }
 
     def reset(self) -> None:
@@ -116,6 +181,10 @@ class KernelStats:
             self._batch = [0, 0, 0, 0.0]
             self._streams.clear()
             self._heal_required = 0
+            self._stages.clear()
+            self._iopool.clear()
+            self._iopool_depth_hwm = 0
+            self._iopool_slowest_s = 0.0
 
 
 # Process-wide singleton: one codec seam per process (backend.py caches

@@ -21,6 +21,8 @@ import uuid
 
 from ..codec import compress as compmod, sse as ssemod
 from ..codec.erasure import Erasure, QuorumError
+from ..parallel import iopool
+from ..parallel.iopool import tag_disk_stream
 from ..storage import errors as serrors
 from ..storage.meta import (
     ErasureInfo,
@@ -179,8 +181,12 @@ class MultipartMixin:
                 continue
             try:
                 writers.append(
-                    d.create_file(
-                        SYS_VOL, f"tmp/{tmp_ids[i]}/part.{part_number}"
+                    tag_disk_stream(
+                        d.create_file(
+                            SYS_VOL,
+                            f"tmp/{tmp_ids[i]}/part.{part_number}",
+                        ),
+                        d,
                     )
                 )
             except Exception:  # noqa: BLE001
@@ -198,25 +204,32 @@ class MultipartMixin:
                         _log.debug("shard writer close failed", extra=kv(err=str(exc)))
             self._cleanup_tmp(disks, tmp_ids)
             raise WriteQuorumError(str(e)) from e
-        for w in writers:
-            if w is not None:
-                try:
-                    w.close()
-                except OSError:
-                    pass
+        # fan the shard-file closes (flush + fsync) out per disk queue
+        for err in iopool.fanout(
+            [
+                (iopool.stream_io_key(w), w.close)
+                for w in writers
+                if w is not None
+            ]
+        ):
+            if err is not None and not isinstance(err, OSError):
+                raise err
         etag = hreader.etag()
         actual = hreader.bytes_read
         mod = now_ns()
-        # commit shard into the upload dir + record part metadata
-        errs = []
+        # commit shard into the upload dir + record part metadata, one
+        # pool job per disk (each commit touches only its own disk)
+        commit_ops = []
+        errs: list = [None] * len(disks)
         for i, d in enumerate(disks):
             if d is None or writers[i] is None:
-                errs.append(serrors.DiskNotFound("offline"))
+                errs[i] = serrors.DiskNotFound("offline")
                 continue
-            try:
+
+            def commit(d=d, tmp=tmp_ids[i]):
                 d.rename_file(
                     SYS_VOL,
-                    f"tmp/{tmp_ids[i]}/part.{part_number}",
+                    f"tmp/{tmp}/part.{part_number}",
                     SYS_VOL,
                     f"{self._mp_path(upload_id)}/part.{part_number}",
                 )
@@ -225,10 +238,14 @@ class MultipartMixin:
                     f"{self._mp_path(upload_id)}/part.{part_number}.meta",
                     f"{total}:{etag}:{mod}:{actual}".encode(),
                 )
-                d.delete_file(SYS_VOL, f"tmp/{tmp_ids[i]}", recursive=True)
-                errs.append(None)
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
+                d.delete_file(SYS_VOL, f"tmp/{tmp}", recursive=True)
+
+            commit_ops.append((i, iopool.disk_io_key(d) or f"disk-{i}", commit))
+        for (i, _k, _f), err in zip(
+            commit_ops,
+            iopool.fanout([(key, fn) for _i, key, fn in commit_ops]),
+        ):
+            errs[i] = err
         reduce_errs(errs, self.write_quorum, WriteQuorumError)
         return PartInfo(
             part_number=part_number,

@@ -14,6 +14,8 @@ import uuid
 
 from ..codec import compress as compmod, erasure as ecodec, sse as ssemod
 from ..codec.erasure import Erasure, QuorumError
+from ..parallel import iopool
+from ..parallel.iopool import tag_disk_stream
 from ..storage import errors as serrors
 from ..storage.meta import (
     ErasureInfo,
@@ -280,8 +282,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 continue
             try:
                 writers.append(
-                    d.create_file(
-                        SYS_VOL, f"tmp/{tmp_ids[i]}/{data_dir}/part.1"
+                    tag_disk_stream(
+                        d.create_file(
+                            SYS_VOL,
+                            f"tmp/{tmp_ids[i]}/{data_dir}/part.1",
+                        ),
+                        d,
                     )
                 )
             except Exception:  # noqa: BLE001
@@ -300,12 +306,18 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         _log.debug("shard writer close failed", extra=kv(err=str(exc)))
             self._cleanup_tmp(disks, tmp_ids)
             raise WriteQuorumError(str(e)) from e
-        for w in writers:
-            if w is not None:
-                try:
-                    w.close()
-                except OSError:
-                    pass
+        # close (flush + fsync) every shard file concurrently, one job
+        # per disk queue: the commit pays the slowest disk's fsync, not
+        # the sum over n disks
+        for err in iopool.fanout(
+            [
+                (iopool.stream_io_key(w), w.close)
+                for w in writers
+                if w is not None
+            ]
+        ):
+            if err is not None and not isinstance(err, OSError):
+                raise err
 
         mod_time = now_ns()
         etag = hreader.etag()
@@ -326,10 +338,14 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             "" if versioned else self._old_null_data_dir(bucket, object_name)
         )
 
-        errs = []
+        # rename_data commits the version journal with its own fsync
+        # per disk: fan the commits out on the disk queues and gather
+        # per-slot errors in order
+        rename_ops = []
+        errs: list = [None] * len(disks)
         for i, d in enumerate(disks):
             if d is None or writers[i] is None:
-                errs.append(serrors.DiskNotFound("offline"))
+                errs[i] = serrors.DiskNotFound("offline")
                 continue
             fi = FileInfo(
                 volume=bucket,
@@ -348,13 +364,20 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     distribution=distribution,
                 ),
             )
-            try:
-                d.rename_data(
-                    SYS_VOL, f"tmp/{tmp_ids[i]}", fi, bucket, object_name
+            rename_ops.append(
+                (
+                    i,
+                    iopool.disk_io_key(d) or f"disk-{i}",
+                    lambda d=d, fi=fi, tmp=tmp_ids[i]: d.rename_data(
+                        SYS_VOL, f"tmp/{tmp}", fi, bucket, object_name
+                    ),
                 )
-                errs.append(None)
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
+            )
+        for (i, _k, _f), err in zip(
+            rename_ops,
+            iopool.fanout([(key, fn) for _i, key, fn in rename_ops]),
+        ):
+            errs[i] = err
         try:
             reduce_errs(errs, self.write_quorum, WriteQuorumError)
         except WriteQuorumError:
@@ -758,9 +781,12 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 continue
             try:
                 readers.append(
-                    d.read_file_stream(
-                        bucket,
-                        f"{object_name}/{fi.data_dir}/part.{part_number}",
+                    tag_disk_stream(
+                        d.read_file_stream(
+                            bucket,
+                            f"{object_name}/{fi.data_dir}/part.{part_number}",
+                        ),
+                        d,
                     )
                 )
             except Exception:  # noqa: BLE001
@@ -1254,18 +1280,24 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     else:
                         try:
                             readers.append(
-                                d.read_file_stream(
-                                    bucket,
-                                    f"{object_name}/{fi.data_dir}/part.{part.number}",
+                                tag_disk_stream(
+                                    d.read_file_stream(
+                                        bucket,
+                                        f"{object_name}/{fi.data_dir}/part.{part.number}",
+                                    ),
+                                    d,
                                 )
                             )
                         except Exception:  # noqa: BLE001
                             readers.append(None)
                 writers = [None] * len(disks)
                 for i in outdated:
-                    writers[i] = disks[i].create_file(
-                        SYS_VOL,
-                        f"tmp/{tmp_ids[i]}/{fi.data_dir}/part.{part.number}",
+                    writers[i] = tag_disk_stream(
+                        disks[i].create_file(
+                            SYS_VOL,
+                            f"tmp/{tmp_ids[i]}/{fi.data_dir}/part.{part.number}",
+                        ),
+                        disks[i],
                     )
                 try:
                     er.heal(readers, writers, part.size)

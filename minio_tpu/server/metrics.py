@@ -340,6 +340,47 @@ class Metrics:
             "Decoded streams that reported shards needing heal",
             [({}, snap["heal_required"])],
         )
+        stages = snap["stages"]
+        emit(
+            "miniotpu_codec_stage_seconds_total", "counter",
+            "Per-stream stage time (assemble/codec/disk) by op",
+            [
+                (
+                    {"op": s["op"], "stage": s["stage"]},
+                    f'{s["seconds"]:.6f}',
+                )
+                for s in stages
+            ],
+        )
+        io = snap["iopool"]
+        emit(
+            "miniotpu_iopool_jobs_total", "counter",
+            "I/O fan-out jobs completed per pool queue",
+            [({"queue": q["queue"]}, q["jobs"]) for q in io["queues"]],
+        )
+        emit(
+            "miniotpu_iopool_bytes_total", "counter",
+            "Shard bytes moved through the I/O fan-out per pool queue",
+            [({"queue": q["queue"]}, q["bytes"]) for q in io["queues"]],
+        )
+        emit(
+            "miniotpu_iopool_busy_seconds_total", "counter",
+            "Worker time spent inside I/O jobs per pool queue",
+            [
+                ({"queue": q["queue"]}, f'{q["busy_seconds"]:.6f}')
+                for q in io["queues"]
+            ],
+        )
+        emit(
+            "miniotpu_iopool_queue_depth_peak", "gauge",
+            "High-water mark of any fan-out queue's backlog",
+            [({}, io["depth_hwm"])],
+        )
+        emit(
+            "miniotpu_iopool_slowest_job_seconds", "gauge",
+            "Longest single I/O job observed (the slowest-disk signal)",
+            [({}, f'{io["slowest_job_seconds"]:.6f}')],
+        )
 
     @staticmethod
     def _emit_disk_api(emit, object_layer):

@@ -50,8 +50,9 @@ def _open_fd_count() -> int:
 
 
 # process-lifetime singletons that start lazily on first use and are
-# shared across every server in the process (NOT per-test leaks)
-_LEAK_ALLOW_PREFIXES = ("codec-batcher", "jax", "grpc")
+# shared across every server in the process (NOT per-test leaks);
+# "iopool" is the global per-disk I/O fan-out plane (parallel/iopool.py)
+_LEAK_ALLOW_PREFIXES = ("codec-batcher", "jax", "grpc", "iopool")
 
 
 @_pytest.fixture()

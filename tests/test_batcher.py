@@ -120,6 +120,54 @@ def test_mixed_geometry_not_merged(inner, batched):
     np.testing.assert_array_equal(rb[0], ref.encode(bdat, 2)[0])
 
 
+def test_pipelined_clients_flush_without_deadline(inner):
+    """Double-buffering clients hold an un-ended handle while they
+    submit the next batch.  Counting those held handles as 'still
+    coming' used to stall every flush to the full deadline; counting
+    DISTINCT submitting clients instead fires the fast path as soon as
+    each pipelined client has one job queued."""
+    import time
+
+    b = BatchingBackend(inner, deadline_s=2.0)  # painful if waited
+    n_clients = 3
+    barrier = threading.Barrier(n_clients)
+    elapsed = [None] * n_clients
+    results = [None] * n_clients
+    datas = [_data(seed=10 + i) for i in range(n_clients)]
+
+    def work(i):
+        # batch 1 held open across batch 2's submission, like the
+        # erasure encoder's double buffer
+        h1 = b.encode_begin(datas[i], 2)
+        barrier.wait()
+        t0 = time.monotonic()
+        h2 = b.encode_begin(_data(seed=20 + i), 2)
+        b.encode_end(h2)
+        elapsed[i] = time.monotonic() - t0
+        results[i] = b.encode_end(h1)
+
+    threads = [
+        threading.Thread(target=work, args=(i,))
+        for i in range(n_clients)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        ref = CpuBackend()
+        for i in range(n_clients):
+            assert elapsed[i] is not None and elapsed[i] < 1.0, (
+                f"client {i} stalled {elapsed[i]}s waiting for a "
+                "deadline flush"
+            )
+            np.testing.assert_array_equal(
+                results[i][0], ref.encode(datas[i], 2)[0]
+            )
+    finally:
+        b.shutdown()
+
+
 def test_error_propagates(batched):
     with pytest.raises(Exception):
         # reconstruct with too few survivors must raise in the caller

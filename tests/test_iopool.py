@@ -1,0 +1,273 @@
+"""Per-disk I/O fan-out plane: ordering, fault injection, quorum.
+
+The iopool is the write/read twin of the reference's parallelWriter /
+parallelReader (erasure-encode.go:39-70, erasure-decode.go:120-160):
+one ordered queue per disk so concurrent callers never interleave a
+shard file's frames, quorum-aware flushes that return early and drain
+stragglers in the background, and dead-disk bookkeeping that mirrors
+the sequential path exactly (writers[s] = None).
+"""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.codec import bitrot
+from minio_tpu.codec.erasure import Erasure, QuorumError
+from minio_tpu.parallel import iopool
+
+from tests.test_erasure import MemShard, NaughtyShard
+
+
+class SlowShard(MemShard):
+    """Writes land, slowly: the straggler disk of a quorum flush."""
+
+    def __init__(self, delay_s: float):
+        super().__init__()
+        self.delay_s = delay_s
+
+    def write(self, b):
+        time.sleep(self.delay_s)
+        super().write(b)
+
+
+def _payload(size, seed):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+
+
+def _verify_shard_file(er, shard, size):
+    """Bitrot-verify every frame of a shard file and return how many
+    blocks it held; any reordered or torn write breaks a digest."""
+    blocks = 0
+    off = 0
+    left = size
+    while left > 0:
+        blen = min(er.block_size, left)
+        slen = er.shard_size_padded(blen)
+        frame = shard.read_at(off, bitrot.DIGEST_SIZE + slen)
+        assert len(frame) == bitrot.DIGEST_SIZE + slen, "short frame"
+        assert bitrot.verify_block(
+            frame[bitrot.DIGEST_SIZE :], frame[: bitrot.DIGEST_SIZE]
+        ), f"bitrot in frame {blocks}"
+        off += bitrot.DIGEST_SIZE + slen
+        left -= blen
+        blocks += 1
+    assert off == len(shard.buf)
+    return blocks
+
+
+# ---- ordering under concurrency ----------------------------------------
+
+
+def test_concurrent_puts_never_reorder_frames(leakcheck):
+    """N concurrent PUTs share the same 4 disks (same pool queues);
+    each object's shard files must come out frame-ordered and intact —
+    the ordered per-disk queue is what makes the fan-out safe."""
+    k, m, bs = 2, 2, 2048
+    n_puts = 4
+    size = 6 * bs + 123
+    ers = [Erasure(k, m, bs) for _ in range(n_puts)]
+    payloads = [_payload(size, 11 + i) for i in range(n_puts)]
+    all_shards = []
+    for _ in range(n_puts):
+        shards = [MemShard() for _ in range(k + m)]
+        for d, s in enumerate(shards):
+            # all PUTs route disk d's writes through ONE pool queue
+            iopool.tag_io_key(s, f"shared-disk-{d}")
+        all_shards.append(shards)
+
+    barrier = threading.Barrier(n_puts)
+    errors = []
+
+    def put(i):
+        try:
+            barrier.wait(timeout=10)
+            ers[i].encode(
+                io.BytesIO(payloads[i]),
+                list(all_shards[i]),
+                write_quorum=k + 1,
+                batch_blocks=2,
+            )
+        except Exception as exc:  # noqa: BLE001 - surfaced below
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=put, args=(i,)) for i in range(n_puts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    for i in range(n_puts):
+        for s in all_shards[i]:
+            _verify_shard_file(ers[i], s, size)
+        out = io.BytesIO()
+        written, heal = ers[i].decode(
+            out, list(all_shards[i]), 0, size, size
+        )
+        assert written == size and not heal
+        assert out.getvalue() == payloads[i]
+
+
+# ---- fault injection ---------------------------------------------------
+
+
+def test_failing_writer_still_reaches_quorum(leakcheck):
+    """A disk that starts erroring mid-stream is marked dead
+    (writers[s] = None) while the surviving shard files stay complete
+    and frame-intact."""
+    k, m, bs = 4, 2, 2048
+    size = 10 * bs
+    er = Erasure(k, m, bs)
+    shards = [MemShard() for _ in range(k + m)]
+    shards[5] = NaughtyShard(ok_calls=2)
+    writers = list(shards)
+    total = er.encode(
+        io.BytesIO(_payload(size, 3)),
+        writers,
+        write_quorum=k + 1,
+        batch_blocks=2,
+    )
+    assert total == size
+    assert writers[5] is None
+    for s in range(k + 1):
+        _verify_shard_file(er, shards[s], size)
+    out = io.BytesIO()
+    readers = list(shards[: k + 1]) + [None]
+    written, _ = er.decode(out, readers, 0, size, size)
+    assert out.getvalue() == _payload(size, 3)
+
+
+def test_slow_writer_drains_to_a_complete_shard_file(leakcheck):
+    """Quorum returns early past a straggler, but encode() settles the
+    background drain before declaring the object durable — the slow
+    disk's shard file must be COMPLETE once encode returns."""
+    k, m, bs = 2, 2, 2048
+    size = 8 * bs
+    er = Erasure(k, m, bs)
+    shards = [MemShard() for _ in range(k + m)]
+    shards[3] = SlowShard(delay_s=0.01)
+    writers = list(shards)
+    total = er.encode(
+        io.BytesIO(_payload(size, 7)),
+        writers,
+        write_quorum=k + 1,
+        batch_blocks=2,
+    )
+    assert total == size
+    assert writers[3] is not None  # slow, not dead
+    for s in shards:
+        assert len(s.buf) == er.shard_file_size(size)
+        _verify_shard_file(er, s, size)
+
+
+def test_quorum_loss_raises_without_deadlock(leakcheck):
+    """Losing write quorum mid-stream raises QuorumError promptly (no
+    hang waiting on acks that can never arrive) and leaves the shared
+    pool healthy for the next caller."""
+    k, m, bs = 4, 2, 2048
+    size = 8 * bs
+    er = Erasure(k, m, bs)
+    shards = [NaughtyShard(ok_calls=1) for _ in range(k + m)]
+    for i in range(k):
+        shards[i] = MemShard()  # only k alive < write_quorum=k+1
+    writers = list(shards)
+    with pytest.raises(QuorumError):
+        er.encode(
+            io.BytesIO(_payload(size, 9)),
+            writers,
+            write_quorum=k + 1,
+            batch_blocks=2,
+        )
+    # the pool survives the failed flush: a fresh job still runs
+    fut = iopool.get_pool().submit("post-quorum-probe", lambda: 41 + 1)
+    assert fut.result_or_raise(timeout=10) == 42
+
+
+# ---- pool lifecycle ----------------------------------------------------
+
+
+def test_private_pool_shutdown_leaves_no_threads():
+    pool = iopool.IOPool(queues=3, depth=4, name_prefix="iopool-t")
+    futs = [
+        pool.submit(f"d{i % 3}", lambda i=i: i * i) for i in range(9)
+    ]
+    assert [f.result_or_raise(timeout=10) for f in futs] == [
+        i * i for i in range(9)
+    ]
+    assert pool.live_workers() > 0
+    pool.shutdown()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and pool.live_workers():
+        time.sleep(0.01)
+    assert pool.live_workers() == 0
+    with pytest.raises(RuntimeError):
+        pool.submit("d0", lambda: None)
+
+
+def test_ordered_queue_preserves_submission_order():
+    pool = iopool.IOPool(queues=2, depth=64, name_prefix="iopool-t")
+    try:
+        seen = []
+        lk = threading.Lock()
+
+        def mark(i):
+            with lk:
+                seen.append(i)
+
+        futs = [
+            pool.submit("one-disk", lambda i=i: mark(i))
+            for i in range(50)
+        ]
+        for f in futs:
+            f.result_or_raise(timeout=10)
+        assert seen == list(range(50))
+    finally:
+        pool.shutdown()
+
+
+# ---- micro-benchmark (guarded, generous) -------------------------------
+
+
+def test_parallel_writes_beat_sequential():
+    """12 disks, each write costing ~4ms of 'seek': the fan-out must
+    land well under the sequential sum.  Generous threshold so CI
+    scheduling noise cannot flake it — ideal speedup is ~12x, we only
+    ask for ~1.4x."""
+    n_disks, rounds, delay = 12, 3, 0.004
+    payload = b"x" * 4096
+
+    disks = [SlowShard(delay) for _ in range(n_disks)]
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for d in disks:
+            d.write(payload)
+    sequential = time.perf_counter() - t0
+
+    disks = [SlowShard(delay) for _ in range(n_disks)]
+    pool = iopool.IOPool(queues=n_disks, depth=8, name_prefix="iopool-t")
+    try:
+        flusher = iopool.ShardFlusher(pool, quorum_exc=RuntimeError)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            jobs = [
+                (s, f"bench-disk-{s}", (lambda d=d: d.write(payload)), len(payload))
+                for s, d in enumerate(disks)
+            ]
+            flusher.flush(jobs, quorum=n_disks)
+        flusher.drain()
+        parallel = time.perf_counter() - t0
+    finally:
+        pool.shutdown()
+
+    for d in disks:
+        assert len(d.buf) == rounds * len(payload)
+    assert parallel < sequential * 0.7, (
+        f"parallel {parallel:.3f}s not faster than "
+        f"sequential {sequential:.3f}s"
+    )

@@ -116,8 +116,13 @@ def test_copy_object_streams_bounded(layer):
 
     peak_small = copy_peak("src16", 16 << 20, 2)
     peak_large = copy_peak("src64", 64 << 20, 5)
-    # 4x the object, ~same peak (slack for allocator noise)
-    assert peak_large < peak_small + (8 << 20), (
+    # 4x the object, ~same peak.  The slack covers the pipelined
+    # codec's bounded in-flight set (read-ahead batch, straggler
+    # write generation, per-worker frame runs): the longer run has
+    # more chances to catch every stage stacked at once, which the
+    # short run's sampled peak may miss.  It stays far below the
+    # 48 MiB object-size delta, so O(size) pinning still fails.
+    assert peak_large < peak_small + (24 << 20), (
         f"peak grew {peak_small >> 20} -> {peak_large >> 20} MiB"
     )
 
