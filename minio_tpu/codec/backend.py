@@ -24,6 +24,9 @@ import threading
 import numpy as np
 
 from ..ops import gf, hash as phash
+from ..utils.log import kv, logger
+
+_log = logger("codec.backend")
 
 
 class CodecBackend:
@@ -33,6 +36,12 @@ class CodecBackend:
     """
 
     name = "abstract"
+
+    # True when encode() computes parity and digests in one fused pass
+    # over the bytes (TPU device pass, native single-pass CPU kernel).
+    # The erasure layer keys its stage accounting on this so the fused
+    # time shows up as "codec_fused" in put_stages breakdowns.
+    fused_encode = False
 
     def encode(self, data: np.ndarray, parity_shards: int):
         """(B, k, L) u8 -> (parity (B, m, L) u8, digests (B, k+m, 8) u32).
@@ -58,6 +67,53 @@ class CodecBackend:
     def verify(self, shards: np.ndarray, digests: np.ndarray) -> np.ndarray:
         """(B, n, L) u8 + (B, n, 8) digests -> (B, n) bool intact mask."""
         return (self.digest(shards) == np.asarray(digests)).all(axis=-1)
+
+    def reconstruct_and_verify(
+        self,
+        shards: np.ndarray,
+        digests: np.ndarray,
+        present: "tuple[bool, ...] | np.ndarray",
+        data_shards: int,
+        parity_shards: int,
+    ) -> "tuple[np.ndarray, np.ndarray]":
+        """Verify digests of present shards AND decode the data rows.
+
+        (B, n, L) u8 + (B, n, 8) digests + present mask ->
+        (data (B, k, L) u8, ok (B, n) bool).  The returned ok mask
+        reflects per-shard digest checks (absent shards are False);
+        decode uses only shards that verified intact.  Raises
+        ValueError when fewer than k shards verify for some stripe.
+        Backends may fuse the two passes; this default composes them.
+        """
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        pres = np.asarray(present, dtype=bool)
+        ok = self.verify(shards, digests) & pres
+        return (
+            self._reconstruct_from_ok(
+                shards, ok, data_shards, parity_shards
+            ),
+            ok,
+        )
+
+    def _reconstruct_from_ok(self, shards, ok, data_shards, parity_shards):
+        """Decode each stripe from its own verified-intact shard set,
+        grouping stripes that share a survivor pattern into one
+        reconstruct call."""
+        B, n, L = shards.shape
+        out = np.empty((B, data_shards, L), dtype=np.uint8)
+        groups: "dict[tuple[bool, ...], list[int]]" = {}
+        for b in range(B):
+            if int(ok[b].sum()) < data_shards:
+                raise ValueError(
+                    f"stripe {b}: {int(ok[b].sum())}/{n} shards intact,"
+                    f" need {data_shards}"
+                )
+            groups.setdefault(tuple(bool(x) for x in ok[b]), []).append(b)
+        for pat, idxs in groups.items():
+            out[idxs] = self.reconstruct(
+                shards[idxs], pat, data_shards, parity_shards
+            )
+        return out
 
     # -- async pipeline seam (erasure-encode.go:73-109 overlap) --------
     #
@@ -86,6 +142,7 @@ class TpuBackend(CodecBackend):
     """
 
     name = "tpu"
+    fused_encode = True  # ops/codec_step fuses encode+hash on device
 
     def __init__(self):
         self._meshes: dict[tuple[int, int], object] = {}
@@ -124,16 +181,16 @@ class TpuBackend(CodecBackend):
         B, k, L = data.shape
         mesh = self._mesh_for(B, k)
         if mesh is not None:
-            # the mesh path synchronizes internally; eager result
+            # shard_map dispatch is as async as plain jit: the mesh
+            # begin/end split returns device-array futures, so the
+            # encode/write overlap survives on the mesh path too
             from ..parallel import mesh as pm
 
-            parity_w, digests = pm.mesh_encode_hash(
+            h = pm.mesh_encode_hash_begin(
                 mesh, codec_step.host_bytes_to_words(data),
                 parity_shards, L,
             )
-            return (
-                codec_step.host_words_to_bytes(parity_w), digests,
-            )
+            return ("async-mesh", h)
         words = jnp.asarray(codec_step.host_bytes_to_words(data))
         parity_w, digests = codec_step.encode_and_hash_words(
             words, parity_shards, L
@@ -143,13 +200,19 @@ class TpuBackend(CodecBackend):
     def encode_end(self, handle):
         if not (
             isinstance(handle, tuple)
-            and len(handle) == 3
+            and len(handle) >= 2
             and isinstance(handle[0], str)
-            and handle[0] == "async"
         ):
             return handle
         from ..ops import codec_step
 
+        if handle[0] == "async-mesh":
+            from ..parallel import mesh as pm
+
+            parity_w, digests = pm.mesh_encode_hash_end(handle[1])
+            return codec_step.host_words_to_bytes(parity_w), digests
+        if handle[0] != "async" or len(handle) != 3:
+            return handle
         _tag, parity_w, digests = handle
         parity = codec_step.host_words_to_bytes(np.asarray(parity_w))
         return parity, np.asarray(digests)
@@ -199,9 +262,79 @@ class TpuBackend(CodecBackend):
 
 
 class CpuBackend(CodecBackend):
+    """Host backend: the whole batch goes through ONE native call per
+    op (fused single-pass encode+hash, batched tiled reconstruct,
+    fused reconstruct+verify), stripe-parallel inside the C layer.
+    Every native entry point has a bit-identical numpy twin used when
+    the toolchain/library is unavailable (warn-once, cached)."""
+
     name = "cpu"
 
+    # None = untried, False = unavailable (decision cached: the
+    # fallback must not re-attempt a failing g++ build per block)
+    _native_ok: "bool | None" = None  # fused batch entry points
+    _native_hash_ok: "bool | None" = None
+
+    _NATIVE_ERRS = (
+        OSError,
+        AttributeError,  # stale .so without the symbol
+        subprocess.CalledProcessError,
+    )
+
+    @property
+    def fused_encode(self):  # type: ignore[override]
+        return CpuBackend._native_ok is not False
+
+    @classmethod
+    def _native_fused(cls):
+        """The native module, or None after a failed build (warn-once)."""
+        if cls._native_ok is False:
+            return None
+        from ..utils import native
+
+        if cls._native_ok is None:
+            try:
+                native.lib()
+                cls._native_ok = True
+            except cls._NATIVE_ERRS as exc:
+                cls._native_ok = False
+                _log.warning(
+                    "native codec unavailable; numpy twin engaged"
+                    " (bit-identical, slower)",
+                    extra=kv(err=str(exc)),
+                )
+                return None
+        return native
+
     def encode(self, data, parity_shards):
+        """Fused single-pass batch encode: ONE native call, no Python
+        per-stripe loop, no full-batch concatenate copy."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        native = self._native_fused()
+        if native is not None:
+            try:
+                return native.encode_and_hash_cpu(data, parity_shards)
+            except self._NATIVE_ERRS as exc:
+                CpuBackend._native_ok = False
+                _log.warning(
+                    "native fused encode failed; numpy twin engaged",
+                    extra=kv(err=str(exc)),
+                )
+        parity = _numpy_encode(data, parity_shards)
+        # digests of data and parity rows hashed separately and
+        # stacked: digest arrays are (B, n, 8) - tiny - so no
+        # full-batch byte concatenate on the fallback path either
+        digests = np.concatenate(
+            [self.digest(data), self.digest(parity)], axis=1
+        )
+        return parity, digests
+
+    def encode_split(self, data, parity_shards):
+        """Legacy split path: per-stripe native matmul round-trips plus
+        a separate full-read digest pass over a concatenated copy.
+        Kept callable as the identity/bench baseline the fused kernel
+        is asserted bit-identical against (tests, bench --codec-micro);
+        not used by the erasure layer."""
         from ..utils import native
 
         data = np.ascontiguousarray(data, dtype=np.uint8)
@@ -211,29 +344,63 @@ class CpuBackend(CodecBackend):
         matrix = gf.parity_matrix(k, m)
         for b in range(B):
             parity[b] = native.gf_matmul_cpu(matrix, data[b])
-        digests = self.digest(
-            np.concatenate([data, parity], axis=1)
-        )
+        digests = self.digest(np.concatenate([data, parity], axis=1))
         return parity, digests
 
     def reconstruct(self, shards, present, data_shards, parity_shards):
-        from ..utils import native
-
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
-        B = shards.shape[0]
-        out = np.empty(
-            (B, data_shards, shards.shape[2]), dtype=np.uint8
-        )
         pres = np.asarray(present, dtype=bool)
-        for b in range(B):
-            out[b] = native.reconstruct_cpu(
-                shards[b], pres, data_shards, parity_shards
-            )
-        return out
+        native = self._native_fused()
+        if native is not None:
+            try:
+                return native.reconstruct_batch_cpu(
+                    shards, pres, data_shards, parity_shards
+                )
+            except self._NATIVE_ERRS as exc:
+                CpuBackend._native_ok = False
+                _log.warning(
+                    "native batch reconstruct failed; numpy twin engaged",
+                    extra=kv(err=str(exc)),
+                )
+        return _numpy_reconstruct(shards, pres, data_shards, parity_shards)
 
-    # None = untried, False = unavailable (decision cached: the
-    # fallback must not re-attempt a failing g++ build per block)
-    _native_hash_ok: "bool | None" = None
+    def reconstruct_and_verify(
+        self, shards, digests, present, data_shards, parity_shards
+    ):
+        """Fused GET-side pass: digest checks + survivor decode in one
+        native memory pass.  Optimistic: decodes from the first k
+        present shards while hashing all of them; on the rare digest
+        mismatch among the chosen survivors, re-picks survivors from
+        the verified mask and reconstructs just the hit stripes."""
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        pres = np.asarray(present, dtype=bool)
+        native = self._native_fused()
+        if native is None:
+            return super().reconstruct_and_verify(
+                shards, digests, pres, data_shards, parity_shards
+            )
+        try:
+            data, ok = native.reconstruct_and_verify_cpu(
+                shards, digests, pres, data_shards, parity_shards
+            )
+        except self._NATIVE_ERRS as exc:
+            CpuBackend._native_ok = False
+            _log.warning(
+                "native fused reconstruct_and_verify failed;"
+                " numpy twin engaged",
+                extra=kv(err=str(exc)),
+            )
+            return super().reconstruct_and_verify(
+                shards, digests, pres, data_shards, parity_shards
+            )
+        surv = np.nonzero(pres)[0][:data_shards]
+        bad = ~ok[:, surv].all(axis=1)
+        if bad.any():
+            idxs = np.nonzero(bad)[0]
+            data[idxs] = self._reconstruct_from_ok(
+                shards[idxs], ok[idxs], data_shards, parity_shards
+            )
+        return data, ok
 
     def digest(self, shards):
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
@@ -246,14 +413,47 @@ class CpuBackend(CodecBackend):
                 out = native.phash256_rows(words, L)
                 CpuBackend._native_hash_ok = True
                 return out
-            except (
-                OSError,
-                AttributeError,  # stale .so without the symbol
-                subprocess.CalledProcessError,
-            ):
+            except self._NATIVE_ERRS:
                 CpuBackend._native_hash_ok = False
         # no toolchain / stale lib: numpy twin (bit-identical, slower)
         return phash.phash256_host_batched(words, L)
+
+
+def _numpy_encode(data: np.ndarray, parity_shards: int) -> np.ndarray:
+    """Vectorized numpy parity twin: loops only over the (m, k) matrix
+    cells, each multiply a batched table gather + XOR over (B, L)."""
+    B, k, L = data.shape
+    m = parity_shards
+    matrix = gf.parity_matrix(k, m)
+    table = gf.mul_table()
+    parity = np.zeros((B, m, L), dtype=np.uint8)
+    for r in range(m):
+        for c in range(k):
+            parity[:, r, :] ^= table[matrix[r, c]][data[:, c, :]]
+    return parity
+
+
+def _numpy_reconstruct(
+    shards: np.ndarray,
+    present: np.ndarray,
+    data_shards: int,
+    parity_shards: int,
+) -> np.ndarray:
+    """Vectorized numpy decode twin of reconstruct_batch_cpu."""
+    B, n, L = shards.shape
+    k = data_shards
+    idx = tuple(int(i) for i in np.nonzero(present)[0])
+    if len(idx) < k:
+        raise ValueError(f"need {k} shards to reconstruct, have {len(idx)}")
+    rm = gf.reconstruction_matrix(k, parity_shards, idx)
+    table = gf.mul_table()
+    surv = shards[:, list(idx[:k]), :]
+    out = np.zeros((B, k, L), dtype=np.uint8)
+    for r in range(k):
+        for c in range(k):
+            if rm[r, c]:
+                out[:, r, :] ^= table[rm[r, c]][surv[:, c, :]]
+    return out
 
 
 _lock = threading.Lock()

@@ -10,7 +10,8 @@ those passes in production:
   resolved backend (``tpu``/``cpu``); plus batcher occupancy (jobs
   coalesced per flush, queue wait) and erasure-stream totals.
 * ``InstrumentedBackend`` - a CodecBackend decorator recording every
-  encode / encode_begin-end / digest / reconstruct through the seam.
+  encode / encode_begin-end / digest / reconstruct /
+  reconstruct_and_verify through the seam.
   It wraps the CONCRETE backend (below the batching layer), so a
   coalesced flush counts as one call and its seconds are real device
   launch time, not queue wait - queue wait is the batcher's own series.
@@ -47,7 +48,9 @@ class KernelStats:
         self._streams: "dict[str, list]" = {}
         self._heal_required = 0
         # per-stream stage breakdown: (op, stage) -> [streams, seconds]
-        # op in {"put","get"}, stage in {"assemble","codec","disk"}
+        # op in {"put","get"}, stage in {"assemble","codec",
+        # "codec_fused","disk"} - codec_fused is encode time on a
+        # backend whose parity+digest pass is fused (erasure._codec_stage)
         self._stages: "dict[tuple[str, str], list]" = {}
         # iopool fan-out plane: queue -> [jobs, bytes, busy_seconds]
         self._iopool: "dict[str, list]" = {}
@@ -206,6 +209,12 @@ class InstrumentedBackend(CodecBackend):
         self.stats = stats if stats is not None else KERNEL_STATS
         self.name = getattr(inner, "name", "unknown")
 
+    @property
+    def fused_encode(self):  # type: ignore[override]
+        # live delegation, not an __init__ snapshot: CpuBackend demotes
+        # this when its native build fails mid-process
+        return getattr(self.inner, "fused_encode", False)
+
     def _timed(self, op: str, nbytes: int, fn):
         t0 = time.monotonic()
         try:
@@ -259,6 +268,20 @@ class InstrumentedBackend(CodecBackend):
             shards.nbytes,
             lambda: self.inner.reconstruct(
                 shards, present, data_shards, parity_shards
+            ),
+        )
+
+    def reconstruct_and_verify(
+        self, shards, digests, present, data_shards, parity_shards
+    ):
+        # explicit delegation: the CodecBackend default would compose
+        # self.verify + self.reconstruct and silently bypass the
+        # inner backend's fused single-pass implementation
+        return self._timed(
+            "reconstruct_and_verify",
+            shards.nbytes,
+            lambda: self.inner.reconstruct_and_verify(
+                shards, digests, present, data_shards, parity_shards
             ),
         )
 

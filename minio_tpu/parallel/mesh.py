@@ -285,6 +285,23 @@ def mesh_encode_hash(
     digests (B, k+m, 8)) as numpy, digest rows in data-then-parity order
     (the contract of ops.codec_step.encode_and_hash_words).
     """
+    return mesh_encode_hash_end(
+        mesh_encode_hash_begin(mesh, words, parity_shards, shard_len)
+    )
+
+
+def mesh_encode_hash_begin(
+    mesh: Mesh, words: np.ndarray, parity_shards: int, shard_len: int
+):
+    """Dispatch the mesh encode+digest WITHOUT synchronizing.
+
+    jax dispatch is async for shard_map exactly as for plain jit: the
+    returned tuple holds device-array futures plus the unpadded batch
+    size.  ``mesh_encode_hash_end`` materializes them, so the erasure
+    layer's double-buffered pipeline (encode_begin/encode_end) overlaps
+    this batch's mesh pass with the previous batch's disk writes on the
+    mesh path too, not just the single-device one.
+    """
     B, k, w = words.shape
     stripe = mesh.shape["stripe"]
     bpad = _bucket_batch(B, stripe)
@@ -295,11 +312,16 @@ def mesh_encode_hash(
     fn = _encode_hash_fn(mesh, k, parity_shards, shard_len)
     dd = put_sharded(mesh, words, P("stripe", "shard", None))
     parity, ddig, pdig = fn(dd)
-    parity = np.asarray(parity)[:B]
+    return parity, ddig, pdig, B
+
+
+def mesh_encode_hash_end(handle):
+    """Materialize a ``mesh_encode_hash_begin`` handle (the sync point)."""
+    parity, ddig, pdig, B = handle
     digests = np.concatenate(
         [np.asarray(ddig)[:B], np.asarray(pdig)[:B]], axis=1
     )
-    return parity, digests
+    return np.asarray(parity)[:B], digests
 
 
 @functools.lru_cache(maxsize=64)

@@ -4,11 +4,26 @@ Builds the shared library on first use (g++ -O3 -mavx2) and caches it under
 native/build/.  This is the CPU fallback erasure backend - the counterpart
 of klauspost/reedsolomon's role in the reference - selected when no TPU is
 present or via MINIO_ERASURE_BACKEND=cpu (BASELINE.json north-star seam).
+
+The built artifact is fingerprinted by a hash of the source file plus the
+compiler flags (``libgf_cpu-<hash>.so``): editing csrc or changing flags
+yields a different path and therefore a rebuild, so a stale library body
+can never be silently loaded (an mtime check misses checkouts and clock
+skew, and the old ``AttributeError`` guard only caught *missing* symbols,
+not stale ones).
+
+The hot entry points are batch-native: ``encode_and_hash_cpu`` runs the
+fused single-pass encode+digest kernel over a whole (B, k, L) batch in ONE
+C call (stripe-parallel inside; ctypes drops the GIL for the duration), and
+``reconstruct_batch_cpu`` / ``reconstruct_and_verify_cpu`` are the decode
+twins.  The per-stripe ``gf_matmul_cpu`` remains for tests and the
+``--codec-micro`` split baseline.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -20,23 +35,64 @@ _ROOT = os.path.dirname(
 )
 _SRC = os.path.join(_ROOT, "native", "csrc", "gf_cpu.cc")
 _BUILD_DIR = os.path.join(_ROOT, "native", "build")
-_SO = os.path.join(_BUILD_DIR, "libgf_cpu.so")
+
+_CFLAGS = ["-O3", "-march=native", "-shared", "-fPIC", "-pthread"]
 
 _lock = threading.Lock()
 _lib: "ctypes.CDLL | None" = None
 
 
+def _fingerprint() -> str:
+    """Hash of the source body + compiler flags: the .so identity."""
+    h = hashlib.sha256()
+    with open(_SRC, "rb") as f:
+        h.update(f.read())
+    h.update(b"\x00" + " ".join(_CFLAGS).encode())
+    return h.hexdigest()[:16]
+
+
+def _so_path() -> str:
+    return os.path.join(_BUILD_DIR, f"libgf_cpu-{_fingerprint()}.so")
+
+
 def _build() -> str:
+    so = _so_path()
+    if os.path.exists(so):
+        return so
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC",
-        "-o", _SO + ".tmp", _SRC,
-    ]
+    tmp = so + f".tmp.{os.getpid()}"
+    cmd = ["g++", *_CFLAGS, "-o", tmp, _SRC]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_SO + ".tmp", _SO)
-    return _SO
+    os.replace(tmp, so)
+    # retire other fingerprints (including the legacy unfingerprinted
+    # libgf_cpu.so) so the build dir doesn't accrete one .so per edit
+    for name in os.listdir(_BUILD_DIR):
+        if (
+            name.startswith("libgf_cpu")
+            and name.endswith(".so")
+            and os.path.join(_BUILD_DIR, name) != so
+        ):
+            try:
+                os.remove(os.path.join(_BUILD_DIR, name))
+            except OSError:
+                pass  # another process may hold/clean it concurrently
+    return so
+
+
+def default_threads() -> int:
+    """Stripe-parallel worker count for the batch entry points.
+
+    ``MINIO_TPU_NATIVE_THREADS`` overrides; defaults to the host's core
+    count.  On a 1-core host this is 1 and the native kernels run
+    strictly inline (no thread spawn).
+    """
+    try:
+        v = int(os.environ.get("MINIO_TPU_NATIVE_THREADS") or 0)
+    except ValueError:
+        v = 0
+    if v > 0:
+        return v
+    return os.cpu_count() or 1
 
 
 def lib() -> ctypes.CDLL:
@@ -51,15 +107,38 @@ def lib() -> ctypes.CDLL:
             ]
             l.gf_matmul.restype = None
             l.gf_has_avx2.restype = ctypes.c_int
-            # a stale prebuilt .so may predate this symbol: its
-            # absence must only disable the hash path, never break
-            # the GF codec entry points that DO exist
+            # fingerprinted paths make a stale body unreachable, but a
+            # hand-copied prebuilt .so could still predate a symbol:
+            # its absence must only disable that entry point, never
+            # break the ones that DO exist
             if hasattr(l, "phash256_rows"):
                 l.phash256_rows.argtypes = [
                     ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
                     ctypes.c_uint64, ctypes.c_void_p,
                 ]
                 l.phash256_rows.restype = None
+            if hasattr(l, "encode_and_hash"):
+                l.encode_and_hash.argtypes = [
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_size_t, ctypes.c_void_p, ctypes.c_char_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+                ]
+                l.encode_and_hash.restype = None
+            if hasattr(l, "reconstruct_batch"):
+                l.reconstruct_batch.argtypes = [
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_size_t, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+                ]
+                l.reconstruct_batch.restype = None
+            if hasattr(l, "reconstruct_and_verify"):
+                l.reconstruct_and_verify.argtypes = [
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.c_size_t, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+                ]
+                l.reconstruct_and_verify.restype = None
             _lib = l
     return _lib
 
@@ -92,6 +171,118 @@ def encode_cpu(data: np.ndarray, parity_shards: int) -> np.ndarray:
     from ..ops import gf
 
     return gf_matmul_cpu(gf.parity_matrix(data.shape[0], parity_shards), data)
+
+
+def encode_and_hash_cpu(
+    data: np.ndarray, parity_shards: int, nthreads: "int | None" = None
+) -> "tuple[np.ndarray, np.ndarray]":
+    """Fused single-pass batch encode+digest: ONE native call per batch.
+
+    data: (B, k, L) uint8, L a multiple of 32.  Returns
+    (parity (B, m, L) uint8, digests (B, k+m, 8) uint32, data rows
+    first) - bit-identical to the split gf_matmul + phash256_rows path
+    and to the numpy/jax twins, but each byte is touched once while
+    L1/L2-hot instead of three times through DRAM.
+    """
+    from ..ops import gf
+
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    B, k, L = data.shape
+    m = parity_shards
+    if L % 32:
+        raise ValueError(f"shard length {L} must be a multiple of 32")
+    parity = np.empty((B, m, L), dtype=np.uint8)
+    digests = np.empty((B, k + m, 8), dtype=np.uint32)
+    matrix = np.ascontiguousarray(
+        gf.parity_matrix(k, m), dtype=np.uint8
+    ).tobytes() if m else b""
+    lib().encode_and_hash(
+        B, k, m, L,
+        data.ctypes.data_as(ctypes.c_void_p),
+        matrix,
+        parity.ctypes.data_as(ctypes.c_void_p),
+        digests.ctypes.data_as(ctypes.c_void_p),
+        nthreads if nthreads is not None else default_threads(),
+    )
+    return parity, digests
+
+
+def _survivors(present: np.ndarray, k: int) -> "tuple[np.ndarray, tuple]":
+    idx = tuple(int(i) for i in np.nonzero(present)[0])
+    if len(idx) < k:
+        raise ValueError(f"need {k} shards to reconstruct, have {len(idx)}")
+    return np.asarray(idx[:k], dtype=np.int32), idx
+
+
+def reconstruct_batch_cpu(
+    shards: np.ndarray,
+    present: np.ndarray,
+    data_shards: int,
+    parity_shards: int,
+    nthreads: "int | None" = None,
+) -> np.ndarray:
+    """Batched native reconstruct: (B, n, L) + mask -> (B, k, L), one call."""
+    from ..ops import gf
+
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    B, n, L = shards.shape
+    k = data_shards
+    surv, idx = _survivors(np.asarray(present, dtype=bool), k)
+    rm = gf.reconstruction_matrix(k, parity_shards, idx)
+    out = np.empty((B, k, L), dtype=np.uint8)
+    lib().reconstruct_batch(
+        B, n, k, L,
+        shards.ctypes.data_as(ctypes.c_void_p),
+        surv.ctypes.data_as(ctypes.c_void_p),
+        np.ascontiguousarray(rm, dtype=np.uint8).tobytes(),
+        out.ctypes.data_as(ctypes.c_void_p),
+        nthreads if nthreads is not None else default_threads(),
+    )
+    return out
+
+
+def reconstruct_and_verify_cpu(
+    shards: np.ndarray,
+    digests: np.ndarray,
+    present: np.ndarray,
+    data_shards: int,
+    parity_shards: int,
+    nthreads: "int | None" = None,
+) -> "tuple[np.ndarray, np.ndarray]":
+    """Fused GET-side pass: verify digests of the present shards AND
+    decode the data rows from the first k of them, one memory pass.
+
+    Returns (data (B, k, L) uint8, ok (B, n) bool).  ``data`` is valid
+    for a stripe only where every chosen survivor verified; the caller
+    re-picks survivors from ``ok`` on the rare bitrot hit.
+    """
+    from ..ops import gf
+
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    digests = np.ascontiguousarray(digests, dtype=np.uint32)
+    B, n, L = shards.shape
+    k = data_shards
+    if L % 32:
+        raise ValueError(f"shard length {L} must be a multiple of 32")
+    pres = np.ascontiguousarray(
+        np.asarray(present, dtype=bool), dtype=np.uint8
+    )
+    surv, idx = _survivors(pres.astype(bool), k)
+    rm = gf.reconstruction_matrix(k, parity_shards, idx)
+    ok = np.empty((B, n), dtype=np.uint8)
+    out = np.empty((B, k, L), dtype=np.uint8)
+    lib().reconstruct_and_verify(
+        B, n, k, L,
+        shards.ctypes.data_as(ctypes.c_void_p),
+        surv.ctypes.data_as(ctypes.c_void_p),
+        np.ascontiguousarray(rm, dtype=np.uint8).tobytes(),
+        digests.ctypes.data_as(ctypes.c_void_p),
+        pres.ctypes.data_as(ctypes.c_void_p),
+        ok.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        nthreads if nthreads is not None else default_threads(),
+    )
+    return out, ok.astype(bool)
 
 
 def reconstruct_cpu(
