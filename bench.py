@@ -31,6 +31,11 @@ reference's klauspost/reedsolomon AVX2 assembly, single-threaded like the
 reference's Go benchmark harness).  North star: >= 8x.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Before trusting a number from an edited tree, run the fast analyzer
+loop over just your diff: `python -m minio_tpu.analysis --changed-only`
+(MTPU404/405 catch exactly the ctypes buffer bugs that corrupt a
+benchmark silently instead of crashing it).
 """
 
 from __future__ import annotations

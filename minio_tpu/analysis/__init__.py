@@ -1,25 +1,33 @@
 """minio_tpu.analysis: project-native static analysis.
 
-Three passes over the codebase's invariants (the Python/JAX stand-ins
+Four passes over the codebase's invariants (the Python/JAX stand-ins
 for the go-vet / staticcheck / race-detector triad the reference MinIO
 leans on):
 
-* ``hotpath_lint``    — AST rules MTPU101-105 (syncs, retrace bombs,
-  swallowed exceptions, metric conventions);
+* ``hotpath_lint``    — AST rules MTPU101-106 (syncs, retrace bombs,
+  swallowed exceptions, metric conventions, stale suppressions);
+* ``abi_contracts``   — ctypes/ABI rules MTPU401-405 across the
+  Python↔C seam (utils/native.py vs native/csrc/gf_cpu.cc);
 * ``kernel_contracts``— abstract-eval contracts MTPU201-204 for every
   jitted codec entry point (CPU-only, via jax.eval_shape);
 * ``lockorder``       — runtime lock-graph audit MTPU301-302.
 
 Run ``python -m minio_tpu.analysis`` (tier-1 runs the same passes via
 tests/test_analysis.py).  Suppress a deliberate violation with
-``# noqa: MTPU###`` on the offending line.
+``# noqa: MTPU###`` on the offending line — MTPU106 flags the noqa
+itself once the rule stops firing there, so suppressions cannot rot.
 """
 
 from __future__ import annotations
 
 import os
 
-from .findings import RULES, Finding, filter_suppressed  # noqa: F401
+from .findings import (  # noqa: F401
+    RULES,
+    Finding,
+    filter_suppressed,
+    unused_suppressions,
+)
 
 REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -31,9 +39,31 @@ REPO_ROOT = os.path.dirname(
 _EXCLUDE_PREFIXES = ("minio_tpu/analysis/",)
 
 
+def _excluded_dir_names() -> "tuple[str, ...]":
+    # canonical list lives with the CLI (module-level constants only,
+    # so the import cannot recurse)
+    from .__main__ import EXCLUDED_DIR_NAMES
+
+    return EXCLUDED_DIR_NAMES
+
+
+def is_excluded(rel_path: str) -> bool:
+    """True when a repo-relative path must not be analyzed."""
+    parts = rel_path.replace(os.sep, "/").split("/")
+    if any(p in _excluded_dir_names() for p in parts[:-1]):
+        return True
+    return rel_path.startswith(_EXCLUDE_PREFIXES)
+
+
 def iter_py_files(paths: "list[str] | None" = None) -> "list[str]":
-    """Repo-relative .py files under ``paths`` (default: minio_tpu/)."""
+    """Repo-relative .py files under ``paths`` (default: minio_tpu/).
+
+    Honors the canonical directory exclusions even for explicitly
+    passed paths: ``--paths native/build`` (or a file inside it) yields
+    nothing rather than analyzing build artifacts.
+    """
     roots = paths or ["minio_tpu"]
+    excluded = _excluded_dir_names()
     out: "list[str]" = []
     for root in roots:
         abs_root = os.path.join(REPO_ROOT, root)
@@ -41,7 +71,7 @@ def iter_py_files(paths: "list[str] | None" = None) -> "list[str]":
             out.append(os.path.relpath(abs_root, REPO_ROOT))
             continue
         for dirpath, dirnames, filenames in os.walk(abs_root):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            dirnames[:] = [d for d in dirnames if d not in excluded]
             for fn in filenames:
                 if fn.endswith(".py"):
                     out.append(
@@ -50,7 +80,7 @@ def iter_py_files(paths: "list[str] | None" = None) -> "list[str]":
                         )
                     )
     out = [p.replace(os.sep, "/") for p in out]
-    return sorted(p for p in out if not p.startswith(_EXCLUDE_PREFIXES))
+    return sorted(p for p in out if not is_excluded(p))
 
 
 def _read_lines(rel_path: str) -> "list[str]":
@@ -61,7 +91,14 @@ def _read_lines(rel_path: str) -> "list[str]":
 
 
 def run_lint(paths: "list[str] | None" = None) -> "list[Finding]":
-    """Hot-path lint over the tree, noqa-filtered and stable-sorted."""
+    """Hot-path lint over the tree, noqa-filtered and stable-sorted.
+
+    Includes MTPU106: every MTPU-coded noqa is audited against the
+    PRE-filter findings of the file-anchored passes (lint, plus the
+    ABI pass for the native seam), so a suppression whose rule no
+    longer fires is itself a finding.
+    """
+    from . import abi_contracts
     from .hotpath_lint import lint_source
 
     findings: "list[Finding]" = []
@@ -69,10 +106,23 @@ def run_lint(paths: "list[str] | None" = None) -> "list[Finding]":
     for rel in iter_py_files(paths):
         lines = _read_lines(rel)
         sources[rel] = lines
-        findings.extend(lint_source(rel, "\n".join(lines) + "\n"))
+        text = "\n".join(lines) + "\n"
+        raw = lint_source(rel, text)
+        findings.extend(raw)
+        raw_for_audit = list(raw)
+        if rel == abi_contracts.PY_REL:
+            raw_for_audit.extend(abi_contracts.raw_run())
+        findings.extend(unused_suppressions(rel, text, raw_for_audit))
     return sorted(
         filter_suppressed(findings, sources), key=Finding.sort_key
     )
+
+
+def run_abi() -> "list[Finding]":
+    """ctypes/ABI contract checks over the native FFI seam."""
+    from . import abi_contracts
+
+    return sorted(abi_contracts.run(), key=Finding.sort_key)
 
 
 def run_contracts() -> "list[Finding]":
@@ -97,6 +147,8 @@ def run_all(
     findings: "list[Finding]" = []
     if "lint" not in skip:
         findings.extend(run_lint(paths))
+    if "abi" not in skip:
+        findings.extend(run_abi())
     if "contracts" not in skip:
         findings.extend(run_contracts())
     if "locks" not in skip:

@@ -3,6 +3,12 @@
 Exit status 0 when the tree is clean, 1 when any finding survives noqa
 filtering — the same contract tier-1 enforces through
 tests/test_analysis.py.
+
+``--changed-only`` narrows the run to what the working tree actually
+touches (vs HEAD, plus untracked files): lint runs over just the
+changed .py files, and the tree-global passes (contracts, abi, locks)
+run only when a file they audit changed.  This keeps the gate fast as
+the tree grows without weakening a full run.
 """
 
 from __future__ import annotations
@@ -10,26 +16,81 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+
+# Canonical directory exclusions for every file-walking pass.  These are
+# names, matched against any path component: build artifacts
+# (native/build/ holds .so files plus whatever a future codegen step
+# drops there) and bytecode caches must never be analyzed, even when a
+# user passes them explicitly via --paths.
+EXCLUDED_DIR_NAMES = ("__pycache__", "build", ".git", ".claude")
+
+# What each tree-global pass actually audits, for --changed-only: the
+# pass runs iff a changed path matches one of its prefixes.
+PASS_TRIGGER_PREFIXES = {
+    "contracts": (
+        "minio_tpu/ops/",
+        "minio_tpu/codec/backend.py",
+        "minio_tpu/analysis/kernel_contracts.py",
+    ),
+    "abi": (
+        "minio_tpu/utils/native.py",
+        "native/csrc/",
+        "minio_tpu/analysis/abi_contracts.py",
+    ),
+    "locks": (
+        "minio_tpu/dsync/",
+        "minio_tpu/storage/metered.py",
+        "minio_tpu/storage/diskcheck.py",
+        "minio_tpu/parallel/iopool.py",
+        "minio_tpu/analysis/lockorder.py",
+    ),
+}
+
+PASSES = ("lint", "abi", "contracts", "locks")
+
+
+def _changed_files(repo_root: str) -> "set[str]":
+    """Repo-relative paths changed vs HEAD, plus untracked files."""
+    out: "set[str]" = set()
+    for args in (
+        ["diff", "--name-only", "HEAD"],
+        ["ls-files", "--others", "--exclude-standard"],
+    ):
+        r = subprocess.run(
+            ["git", "-C", repo_root, *args],
+            capture_output=True,
+            text=True,
+        )
+        if r.returncode == 0:
+            out.update(
+                ln.strip() for ln in r.stdout.splitlines() if ln.strip()
+            )
+    return out
 
 
 def main(argv: "list[str] | None" = None) -> int:
     # contract checks must not require an accelerator
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    from . import RULES, run_all
+    from . import REPO_ROOT, RULES, run_all
 
     ap = argparse.ArgumentParser(
         prog="python -m minio_tpu.analysis",
         description="minio-tpu project-native static analysis "
-        "(hot-path lint, kernel contracts, lock-order audit)",
+        "(hot-path lint, ABI contracts, kernel contracts, lock-order "
+        "audit)",
+        epilog="directories named "
+        + ", ".join(EXCLUDED_DIR_NAMES)
+        + " are always excluded from file-walking passes",
     )
     ap.add_argument(
         "--paths",
         nargs="*",
         default=None,
         help="repo-relative files/dirs to lint (default: minio_tpu/); "
-        "contract and lock passes are tree-global regardless",
+        "contract, abi and lock passes are tree-global regardless",
     )
     ap.add_argument(
         "--json",
@@ -40,8 +101,15 @@ def main(argv: "list[str] | None" = None) -> int:
         "--skip",
         nargs="*",
         default=[],
-        choices=["lint", "contracts", "locks"],
+        choices=list(PASSES),
         help="passes to skip",
+    )
+    ap.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="analyze only what the working tree changes vs HEAD "
+        "(lint: changed .py files; tree-global passes: run only when "
+        "a file they audit changed)",
     )
     ap.add_argument(
         "--list-rules",
@@ -55,7 +123,26 @@ def main(argv: "list[str] | None" = None) -> int:
             print(f"{rule}  {desc}")
         return 0
 
-    findings = run_all(paths=args.paths, skip=set(args.skip))
+    skip = set(args.skip)
+    paths = args.paths
+    suffix = ""
+    if args.changed_only:
+        suffix = ", changed-only"
+        changed = _changed_files(REPO_ROOT)
+        lint_paths = sorted(
+            p
+            for p in changed
+            if p.endswith(".py") and p.startswith("minio_tpu/")
+        )
+        if lint_paths:
+            paths = lint_paths
+        else:
+            skip.add("lint")
+        for pass_name, prefixes in PASS_TRIGGER_PREFIXES.items():
+            if not any(p.startswith(prefixes) for p in changed):
+                skip.add(pass_name)
+
+    findings = run_all(paths=paths, skip=skip)
 
     if args.json:
         print(
@@ -66,14 +153,10 @@ def main(argv: "list[str] | None" = None) -> int:
     else:
         for f in findings:
             print(f.render())
-        ran = [
-            p
-            for p in ("lint", "contracts", "locks")
-            if p not in set(args.skip)
-        ]
+        ran = [p for p in PASSES if p not in skip]
         print(
             f"minio_tpu.analysis: {len(findings)} finding(s) "
-            f"[{', '.join(ran)}]",
+            f"[{', '.join(ran) or 'nothing to run'}{suffix}]",
             file=sys.stderr,
         )
     return 1 if findings else 0

@@ -1,0 +1,569 @@
+"""ABI contract checker: the Python↔C seam of the native codec (MTPU4xx).
+
+PR 4 moved the PUT/GET hot path into hand-written C++
+(``native/csrc/gf_cpu.cc``) reached through ctypes bindings in
+``minio_tpu/utils/native.py``.  None of the other analysis passes can
+see across that boundary: an argtypes list that drifts from the C
+signature corrupts memory silently, and a length argument computed from
+the wrong array is a heap overflow the type system never sees.  This
+pass cross-checks the two sides statically:
+
+* MTPU401 — arity drift: the ctypes ``argtypes`` list has a different
+  length than the export's C parameter list (or than its ``@ctypes``
+  annotation);
+* MTPU402 — argtypes/restype drift: the binding's ctypes signature
+  differs from the export's declared ``// @ctypes`` annotation;
+* MTPU403 — orphan: an exported symbol with no ctypes binding, or a
+  binding for a symbol the library does not export;
+* MTPU404 — length/pointer mismatch: a ``.ctypes.data_as()`` buffer
+  pointer passed alongside a length argument whose value provably
+  derives from a *different* array's ``.shape`` (AST provenance);
+* MTPU405 — unchecked buffer: a numpy array reaches
+  ``.ctypes.data_as()`` without contiguity evidence on its def-use
+  chain (``np.ascontiguousarray`` / ``np.require`` / an assert on
+  ``.flags.c_contiguous``); a non-contiguous view handed to C reads or
+  writes the wrong bytes.
+
+The C side is parsed from the ``extern "C"`` block; each export carries
+a ``// @ctypes name(argtypes...) -> restype`` annotation comment that
+states the intended ctypes signature (the authoritative side for
+MTPU402 — C pointer types are ambiguous between ``c_void_p`` and
+``c_char_p``).  The Python side is parsed from the AST: any
+``<lib>.<symbol>.argtypes / .restype`` assignment is a binding, and
+every function touching ``.ctypes.data_as()`` gets the MTPU404/405
+data-flow treatment.
+
+Both sides are pure text/AST analysis — the pass never compiles or
+loads the library, so it runs anywhere the lint pass runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from .findings import Finding, filter_suppressed
+
+# the one FFI seam in the tree; fixtures route through analyze() instead
+PY_REL = "minio_tpu/utils/native.py"
+CC_REL = "native/csrc/gf_cpu.cc"
+
+# ---------------------------------------------------------------------------
+# C side: extern "C" export table + @ctypes annotations
+# ---------------------------------------------------------------------------
+
+# args capture is greedy: nested parens (POINTER(c_void_p)) end before
+# the final `) ->`, and annotations are single-line comments.
+_ANNOT_RE = re.compile(
+    r"//\s*@ctypes\s+(?P<name>[A-Za-z_]\w*)\s*\((?P<args>.*)\)"
+    r"\s*->\s*(?P<restype>[\w()]+)"
+)
+
+# a definition inside the extern block: `<type words> <name>(<params>) {`
+# anchored at line start so control flow (`for (...) {`) cannot match —
+# those have a single identifier before the paren, this needs two.
+_FUNC_RE = re.compile(
+    r"^[ \t]*(?!//)(?P<ret>[A-Za-z_][A-Za-z0-9_ \t*]*?)\b"
+    r"(?P<name>[A-Za-z_]\w*)\s*\((?P<params>[^)]*)\)\s*\{",
+    re.M | re.S,
+)
+
+
+@dataclasses.dataclass
+class Export:
+    """One ``extern "C"`` function and its declared ctypes contract."""
+
+    name: str
+    line: int  # def line in the .cc file
+    c_arity: int
+    annot_args: "list[str] | None" = None
+    annot_restype: "str | None" = None
+
+
+def _split_args(text: str) -> "list[str]":
+    """Split an arg list on top-level commas (POINTER(...) stays whole)."""
+    out, depth, cur = [], 0, ""
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append(cur.strip())
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        out.append(cur.strip())
+    return out
+
+
+def _extern_c_block(text: str) -> "tuple[str, int]":
+    """The extern "C" { ... } body and the line offset of its start."""
+    m = re.search(r'extern\s+"C"\s*\{', text)
+    if m is None:
+        return "", 0
+    start = m.end()
+    depth = 1
+    i = start
+    while i < len(text) and depth:
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+        i += 1
+    return text[start:i], text[:start].count("\n")
+
+
+def parse_exports(cc_text: str) -> "dict[str, Export]":
+    """Name -> Export for every function in the extern "C" block."""
+    block, line0 = _extern_c_block(cc_text)
+    annots: "dict[str, tuple[list[str], str]]" = {}
+    for m in _ANNOT_RE.finditer(block):
+        annots[m.group("name")] = (
+            _split_args(m.group("args")),
+            m.group("restype").strip(),
+        )
+    exports: "dict[str, Export]" = {}
+    for m in _FUNC_RE.finditer(block):
+        name = m.group("name")
+        params = m.group("params").strip()
+        arity = 0 if params in ("", "void") else len(_split_args(params))
+        exp = Export(
+            name=name,
+            line=line0 + block[: m.start()].count("\n") + 1,
+            c_arity=arity,
+        )
+        if name in annots:
+            exp.annot_args, exp.annot_restype = annots[name]
+        exports[name] = exp
+    return exports
+
+
+# ---------------------------------------------------------------------------
+# Python side: ctypes binding assignments
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Binding:
+    """ctypes signature assignments for one symbol in the loader."""
+
+    name: str
+    argtypes: "list[str] | None" = None
+    argtypes_line: int = 0
+    restype: "str | None" = None
+    restype_line: int = 0
+
+    @property
+    def anchor(self) -> int:
+        return self.argtypes_line or self.restype_line or 1
+
+
+def _canon(node: ast.AST) -> str:
+    """A ctypes expression as annotation-comparable text."""
+    return ast.unparse(node).replace("ctypes.", "").replace(" ", "")
+
+
+def parse_bindings(py_tree: ast.AST) -> "dict[str, Binding]":
+    """Every ``<obj>.<symbol>.argtypes / .restype`` assignment."""
+    bindings: "dict[str, Binding]" = {}
+    for node in ast.walk(py_tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (
+            isinstance(tgt, ast.Attribute)
+            and tgt.attr in ("argtypes", "restype")
+            and isinstance(tgt.value, ast.Attribute)
+        ):
+            continue
+        sym = tgt.value.attr
+        b = bindings.setdefault(sym, Binding(name=sym))
+        if tgt.attr == "argtypes":
+            b.argtypes_line = node.lineno
+            if isinstance(node.value, (ast.List, ast.Tuple)):
+                b.argtypes = [_canon(e) for e in node.value.elts]
+        else:
+            b.restype_line = node.lineno
+            b.restype = _canon(node.value)
+    return bindings
+
+
+# ---------------------------------------------------------------------------
+# MTPU404 / MTPU405: buffer/length data-flow over the caller functions
+# ---------------------------------------------------------------------------
+
+_SANITIZERS = ("ascontiguousarray", "require")
+
+
+def _is_data_as(node: ast.AST) -> "ast.AST | None":
+    """The buffer expression X for an ``X.ctypes.data_as(...)`` call."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "data_as"
+        and isinstance(node.func.value, ast.Attribute)
+        and node.func.value.attr == "ctypes"
+    ):
+        return node.func.value.value
+    return None
+
+
+class _BufferFlow:
+    """Per-function provenance walk behind MTPU404/405.
+
+    Deliberately sequential (loop/branch bodies visited once, in order)
+    — the FFI wrappers it audits are straight-line code, and a
+    heuristic that over-approximates provenance only ever *misses* a
+    mismatch, it cannot invent one.
+    """
+
+    def __init__(self, rel_path: str, findings: "list[Finding]"):
+        self.rel = rel_path
+        self.findings = findings
+        # var -> the original array names its value derives from
+        self.roots: "dict[str, set[str]]" = {}
+        # var -> array names whose .shape its value derives from
+        self.shape_src: "dict[str, set[str]]" = {}
+        # parameter-rooted names with no contiguity evidence yet
+        self.unsafe: "set[str]" = set()
+
+    # -- provenance helpers --------------------------------------------
+
+    def _name_roots(self, node: ast.AST) -> "set[str]":
+        out: "set[str]" = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                out |= self.roots.get(n.id, {n.id})
+        return out
+
+    def _shape_roots(self, node: ast.AST) -> "set[str]":
+        out: "set[str]" = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr == "shape":
+                out |= self._name_roots(n.value)
+            elif isinstance(n, ast.Name):
+                out |= self.shape_src.get(n.id, set())
+        return out
+
+    def _is_sanitizer(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else ""
+        )
+        if fname in _SANITIZERS:
+            return True
+        return fname == "asarray" and any(
+            kw.arg == "order" for kw in node.keywords
+        )
+
+    def _expr_unsafe(self, node: ast.AST) -> bool:
+        """Does this value's contiguity trace back to a raw parameter?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.unsafe
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self._expr_unsafe(node.value)
+        if isinstance(node, ast.Call):
+            if self._is_sanitizer(node):
+                return False
+            # a method call inherits its receiver's safety (x.reshape
+            # of a raw parameter can be non-contiguous); plain calls
+            # (np.empty, helper functions) allocate fresh arrays
+            if isinstance(node.func, ast.Attribute):
+                return self._expr_unsafe(node.func.value)
+            return False
+        return any(self._expr_unsafe(c) for c in ast.iter_child_nodes(node))
+
+    # -- statement walk ------------------------------------------------
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        args = fn.args
+        params = [
+            a.arg
+            for a in (
+                list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            )
+        ]
+        self.roots = {p: {p} for p in params}
+        self.shape_src = {}
+        self.unsafe = set(params)
+        self._body(fn.body)
+
+    def _assign(self, targets: "list[ast.AST]", value: ast.AST) -> None:
+        roots = self._name_roots(value)
+        shape = self._shape_roots(value)
+        unsafe = self._expr_unsafe(value) and not self._is_sanitizer(value)
+        names: "list[str]" = []
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    names.append(n.id)
+        for name in names:
+            self.roots[name] = roots
+            self.shape_src[name] = shape
+            if unsafe:
+                self.unsafe.add(name)
+            else:
+                self.unsafe.discard(name)
+
+    def _handle_assert(self, node: ast.Assert) -> None:
+        for n in ast.walk(node.test):
+            attr = None
+            if isinstance(n, ast.Attribute) and n.attr in (
+                "c_contiguous",
+                "contiguous",
+            ):
+                attr = n.value
+            elif isinstance(n, ast.Subscript):
+                attr = n.value
+            if (
+                isinstance(attr, ast.Attribute)
+                and attr.attr == "flags"
+                and isinstance(attr.value, ast.Name)
+            ):
+                self.unsafe.discard(attr.value.id)
+
+    def _check_calls(self, node: "ast.AST | None") -> None:
+        if node is None:
+            return
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            base = _is_data_as(call)
+            if base is not None:
+                if self._expr_unsafe(base):
+                    self.findings.append(
+                        Finding(
+                            "MTPU405",
+                            self.rel,
+                            call.lineno,
+                            f"buffer {ast.unparse(base)} reaches "
+                            ".ctypes.data_as() with no contiguity "
+                            "evidence (np.ascontiguousarray / "
+                            "np.require / .flags.c_contiguous assert)",
+                        )
+                    )
+                continue
+            ptr_bases = [
+                b for b in (_is_data_as(a) for a in call.args) if b is not None
+            ]
+            if not ptr_bases:
+                continue
+            ptr_roots: "set[str]" = set()
+            for b in ptr_bases:
+                ptr_roots |= self._name_roots(b)
+            for i, arg in enumerate(call.args):
+                if _is_data_as(arg) is not None:
+                    continue
+                sroots = self._shape_roots(arg)
+                if sroots and sroots.isdisjoint(ptr_roots):
+                    self.findings.append(
+                        Finding(
+                            "MTPU404",
+                            self.rel,
+                            call.lineno,
+                            f"length argument #{i + 1} "
+                            f"({ast.unparse(arg)}) derives from "
+                            f"{sorted(sroots)} but the buffer pointers "
+                            f"come from {sorted(ptr_roots)}",
+                        )
+                    )
+
+    def _body(self, stmts: "list[ast.stmt]") -> None:
+        for st in stmts:
+            if isinstance(st, ast.Assign):
+                self._check_calls(st.value)
+                self._assign(st.targets, st.value)
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                self._check_calls(st.value)
+                self._assign([st.target], st.value)
+            elif isinstance(st, ast.AugAssign):
+                self._check_calls(st.value)
+            elif isinstance(st, ast.Assert):
+                self._handle_assert(st)
+            elif isinstance(st, ast.For):
+                self._check_calls(st.iter)
+                self._assign([st.target], st.iter)
+                # element iteration: a raw iterable yields raw elements
+                if any(
+                    isinstance(n, ast.Name) and n.id in self.unsafe
+                    for n in ast.walk(st.iter)
+                ):
+                    for n in ast.walk(st.target):
+                        if isinstance(n, ast.Name):
+                            self.unsafe.add(n.id)
+                self._body(st.body)
+                self._body(st.orelse)
+            elif isinstance(st, (ast.If, ast.While)):
+                self._check_calls(st.test)
+                self._body(st.body)
+                self._body(st.orelse)
+            elif isinstance(st, ast.With):
+                self._body(st.body)
+            elif isinstance(st, ast.Try):
+                self._body(st.body)
+                for h in st.handlers:
+                    self._body(h.body)
+                self._body(st.orelse)
+                self._body(st.finalbody)
+            elif isinstance(st, (ast.Return, ast.Expr)):
+                self._check_calls(st.value)
+            elif isinstance(st, ast.FunctionDef):
+                _BufferFlow(self.rel, self.findings).run(st)
+            else:
+                self._check_calls(st)
+
+
+# ---------------------------------------------------------------------------
+# cross-checks + entry points
+# ---------------------------------------------------------------------------
+
+
+def _check_cross(
+    exports: "dict[str, Export]",
+    bindings: "dict[str, Binding]",
+    py_rel: str,
+    cc_rel: str,
+    findings: "list[Finding]",
+) -> None:
+    for name, exp in sorted(exports.items()):
+        b = bindings.get(name)
+        if b is None:
+            findings.append(
+                Finding(
+                    "MTPU403",
+                    cc_rel,
+                    exp.line,
+                    f"exported symbol {name} has no ctypes binding in "
+                    f"{py_rel}",
+                )
+            )
+            continue
+        if exp.annot_args is not None and len(exp.annot_args) != exp.c_arity:
+            findings.append(
+                Finding(
+                    "MTPU401",
+                    cc_rel,
+                    exp.line,
+                    f"@ctypes annotation for {name} declares "
+                    f"{len(exp.annot_args)} argument(s) but the C "
+                    f"signature has {exp.c_arity}",
+                )
+            )
+        bound_arity = len(b.argtypes) if b.argtypes is not None else 0
+        if bound_arity != exp.c_arity:
+            findings.append(
+                Finding(
+                    "MTPU401",
+                    py_rel,
+                    b.anchor,
+                    f"binding for {name} declares {bound_arity} "
+                    f"argtypes but the export takes {exp.c_arity} "
+                    "parameter(s)",
+                )
+            )
+        elif exp.annot_args is not None and b.argtypes is not None:
+            bad = [
+                f"#{i + 1}: bound {got}, declared {want}"
+                for i, (got, want) in enumerate(
+                    zip(b.argtypes, exp.annot_args)
+                )
+                if got != want
+            ]
+            if bad:
+                findings.append(
+                    Finding(
+                        "MTPU402",
+                        py_rel,
+                        b.argtypes_line,
+                        f"argtypes drift for {name} vs its @ctypes "
+                        f"annotation ({'; '.join(bad)})",
+                    )
+                )
+        if exp.annot_restype is not None:
+            got = b.restype if b.restype is not None else "c_int"
+            if got != exp.annot_restype:
+                findings.append(
+                    Finding(
+                        "MTPU402",
+                        py_rel,
+                        b.restype_line or b.anchor,
+                        f"restype drift for {name}: bound {got}, "
+                        f"declared {exp.annot_restype} (unset restype "
+                        "defaults to c_int)",
+                    )
+                )
+    for name, b in sorted(bindings.items()):
+        if name not in exports:
+            findings.append(
+                Finding(
+                    "MTPU403",
+                    py_rel,
+                    b.anchor,
+                    f"ctypes binding for {name} has no exported symbol "
+                    f"in {cc_rel}",
+                )
+            )
+
+
+def analyze(
+    py_text: str,
+    py_rel: str,
+    cc_text: "str | None" = None,
+    cc_rel: "str | None" = None,
+) -> "list[Finding]":
+    """All MTPU4xx findings for one binding file (pre-noqa filtering).
+
+    With ``cc_text`` the export cross-checks (MTPU401-403) run too;
+    without it only the caller-side data-flow rules (MTPU404/405).
+    """
+    findings: "list[Finding]" = []
+    try:
+        tree = ast.parse(py_text)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "MTPU401",
+                py_rel,
+                e.lineno or 1,
+                f"binding file does not parse: {e.msg}",
+            )
+        ]
+    if cc_text is not None:
+        _check_cross(
+            parse_exports(cc_text),
+            parse_bindings(tree),
+            py_rel,
+            cc_rel or CC_REL,
+            findings,
+        )
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            _BufferFlow(py_rel, findings).run(node)
+    return findings
+
+
+def raw_run() -> "list[Finding]":
+    """The real seam's findings BEFORE noqa filtering (MTPU106 input)."""
+    from . import REPO_ROOT
+
+    with open(os.path.join(REPO_ROOT, PY_REL), encoding="utf-8") as fh:
+        py_text = fh.read()
+    with open(os.path.join(REPO_ROOT, CC_REL), encoding="utf-8") as fh:
+        cc_text = fh.read()
+    return analyze(py_text, PY_REL, cc_text, CC_REL)
+
+
+def run() -> "list[Finding]":
+    """ABI pass over the real native seam, noqa-filtered."""
+    from . import REPO_ROOT
+
+    with open(os.path.join(REPO_ROOT, PY_REL), encoding="utf-8") as fh:
+        py_lines = fh.read().splitlines()
+    findings = raw_run()
+    return filter_suppressed(findings, {PY_REL: py_lines})

@@ -14,7 +14,8 @@ import dataclasses
 import re
 
 # Rule catalog.  1xx = hot-path lint (AST), 2xx = kernel contract
-# checker (abstract eval), 3xx = lock-order auditor (runtime shim).
+# checker (abstract eval), 3xx = lock-order auditor (runtime shim),
+# 4xx = ctypes/ABI contract checker (native FFI seam).
 RULES: "dict[str, str]" = {
     "MTPU101": (
         "host-device sync (block_until_ready / jax.device_get / .item() / "
@@ -38,6 +39,11 @@ RULES: "dict[str, str]" = {
         "prometheus label-key hygiene: label keys must match "
         "[a-z_][a-z0-9_]*"
     ),
+    "MTPU106": (
+        "unused suppression: a `# noqa: MTPU###` whose rule does not "
+        "fire on that line (stale suppressions rot; silence MTPU106 "
+        "itself on the line to keep one deliberately)"
+    ),
     "MTPU201": "kernel contract: wrong output dtype from a jitted entry point",
     "MTPU202": "kernel contract: wrong output shape from a jitted entry point",
     "MTPU203": (
@@ -51,6 +57,27 @@ RULES: "dict[str, str]" = {
     "MTPU302": (
         "blocking call (sleep / socket connect / subprocess) while "
         "holding a registered hot-path lock"
+    ),
+    "MTPU401": (
+        "ABI contract: ctypes binding arity differs from the native "
+        "export's C parameter count (or annotation disagrees with the "
+        "C signature)"
+    ),
+    "MTPU402": (
+        "ABI contract: argtypes/restype drift between a ctypes binding "
+        "and the export's declared `// @ctypes` annotation"
+    ),
+    "MTPU403": (
+        "ABI contract: exported symbol with no ctypes binding, or a "
+        "binding for a symbol the library does not export"
+    ),
+    "MTPU404": (
+        "ABI contract: buffer pointer passed to native code with a "
+        "length argument computed from a different array's shape"
+    ),
+    "MTPU405": (
+        "ABI contract: numpy buffer reaches .ctypes.data_as() without "
+        "contiguity evidence (ascontiguousarray/require/flags assert)"
     ),
 }
 
@@ -119,4 +146,60 @@ def filter_suppressed(
             if codes is not None and (not codes or f.rule in codes):
                 continue
         out.append(f)
+    return out
+
+
+# Only codes of the file-anchored passes are audited for staleness: 1xx
+# (lint) and 4xx (ABI) anchor at source lines, so "does it fire here"
+# is well-defined.  Foreign codes (BLE001, F401, ...) belong to other
+# tools; MTPU106 on a line is the sanctioned keep-this-suppression
+# escape hatch and MTPU100 is the syntax-error sentinel.
+_AUDITED_PREFIXES = ("MTPU1", "MTPU4")
+_AUDIT_EXEMPT = ("MTPU100", "MTPU106")
+
+
+def unused_suppressions(
+    rel_path: str, text: str, raw_findings: "list[Finding]"
+) -> "list[Finding]":
+    """MTPU106: noqa'd MTPU rules that do not fire on their line.
+
+    ``raw_findings`` must be PRE-noqa-filter findings for this file
+    from every file-anchored pass whose codes the file suppresses —
+    otherwise a working suppression looks unused.  Comments are found
+    with tokenize, so a ``# noqa:`` inside a docstring is ignored.
+    """
+    import io
+    import tokenize
+
+    fired: "dict[int, set[str]]" = {}
+    for f in raw_findings:
+        fired.setdefault(f.line, set()).add(f.rule)
+    out: "list[Finding]" = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out  # broken files are MTPU100's problem, not ours
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        codes = noqa_codes_for_line(tok.string)
+        if not codes:
+            continue  # no noqa, or a bare one (out of audit scope)
+        line = tok.start[0]
+        for code in sorted(codes):
+            if not code.startswith(_AUDITED_PREFIXES):
+                continue
+            if code in _AUDIT_EXEMPT:
+                continue
+            if code not in fired.get(line, ()):
+                out.append(
+                    Finding(
+                        "MTPU106",
+                        rel_path,
+                        line,
+                        f"unused suppression: {code} does not fire on "
+                        "this line; drop the noqa (or add MTPU106 to "
+                        "it to keep deliberately)",
+                    )
+                )
     return out

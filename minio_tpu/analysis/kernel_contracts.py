@@ -49,6 +49,11 @@ _BATCH = 3  # leading batch dim for the batched kernels
 
 
 def _ops_modules():
+    # codec.backend is watched too: the PR 4 fused-codec seams
+    # (encode_and_hash / reconstruct_and_verify) route through backend
+    # objects, and a jitted wrapper landing there without a contract
+    # must fail MTPU204 the same as one in ops/.
+    from minio_tpu.codec import backend
     from minio_tpu.ops import codec_step, hash as phash, rs, rs_pallas
 
     return {
@@ -56,6 +61,7 @@ def _ops_modules():
         "rs_pallas": rs_pallas,
         "codec_step": codec_step,
         "hash": phash,
+        "backend": backend,
     }
 
 

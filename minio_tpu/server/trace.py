@@ -188,7 +188,7 @@ class ConsoleCapture(logging.Handler):
                     "msg": record.getMessage(),
                 }
             )
-        except Exception:  # noqa: MTPU103 - logging must never raise
+        except Exception:  # logging must never raise; count the drop
             self.dropped += 1
 
     def install(self) -> "ConsoleCapture":

@@ -38,38 +38,68 @@ _BUILD_DIR = os.path.join(_ROOT, "native", "build")
 
 _CFLAGS = ["-O3", "-march=native", "-shared", "-fPIC", "-pthread"]
 
+# ASan+UBSan build variant (MINIO_TPU_SANITIZE=1): undefined behaviour
+# is fatal (-fno-sanitize-recover), frames are kept for readable
+# reports.  -O1 instead of -O3: redzone checks dominate anyway and the
+# sanitized library exists for the slow test sweep, not for speed.
+_SAN_CFLAGS = [
+    "-O1",
+    "-g",
+    "-fno-omit-frame-pointer",
+    "-fsanitize=address,undefined",
+    "-fno-sanitize-recover=all",
+]
+
 _lock = threading.Lock()
-_lib: "ctypes.CDLL | None" = None
+_libs: "dict[str, ctypes.CDLL]" = {}
 
 
-def _fingerprint() -> str:
+def _variant() -> str:
+    """"" for the production build, "san" under MINIO_TPU_SANITIZE=1."""
+    return "san" if os.environ.get("MINIO_TPU_SANITIZE") == "1" else ""
+
+
+def _flags(variant: str = "") -> "list[str]":
+    if variant == "san":
+        return [f for f in _CFLAGS if f != "-O3"] + _SAN_CFLAGS
+    return list(_CFLAGS)
+
+
+def _fingerprint(variant: str = "") -> str:
     """Hash of the source body + compiler flags: the .so identity."""
     h = hashlib.sha256()
     with open(_SRC, "rb") as f:
         h.update(f.read())
-    h.update(b"\x00" + " ".join(_CFLAGS).encode())
+    h.update(b"\x00" + " ".join(_flags(variant)).encode())
     return h.hexdigest()[:16]
 
 
-def _so_path() -> str:
-    return os.path.join(_BUILD_DIR, f"libgf_cpu-{_fingerprint()}.so")
+def _so_path(variant: str = "") -> str:
+    suffix = f"-{variant}" if variant else ""
+    return os.path.join(
+        _BUILD_DIR, f"libgf_cpu-{_fingerprint(variant)}{suffix}.so"
+    )
 
 
-def _build() -> str:
-    so = _so_path()
+def _build(variant: str = "") -> str:
+    so = _so_path(variant)
     if os.path.exists(so):
         return so
     os.makedirs(_BUILD_DIR, exist_ok=True)
     tmp = so + f".tmp.{os.getpid()}"
-    cmd = ["g++", *_CFLAGS, "-o", tmp, _SRC]
+    cmd = ["g++", *_flags(variant), "-o", tmp, _SRC]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so)
-    # retire other fingerprints (including the legacy unfingerprinted
-    # libgf_cpu.so) so the build dir doesn't accrete one .so per edit
+    # retire other fingerprints OF THE SAME VARIANT (including the
+    # legacy unfingerprinted libgf_cpu.so) so the build dir doesn't
+    # accrete one .so per edit; the sanitized and production artifacts
+    # coexist - pruning across variants would force a rebuild on every
+    # alternation between the test sweep and normal runs
     for name in os.listdir(_BUILD_DIR):
         if (
             name.startswith("libgf_cpu")
             and name.endswith(".so")
+            and name.endswith("-san.so") == (variant == "san")
             and os.path.join(_BUILD_DIR, name) != so
         ):
             try:
@@ -96,16 +126,21 @@ def default_threads() -> int:
 
 
 def lib() -> ctypes.CDLL:
-    global _lib
+    variant = _variant()
     with _lock:
-        if _lib is None:
-            l = ctypes.CDLL(_build())
+        if variant not in _libs:
+            l = ctypes.CDLL(_build(variant))
             l.gf_matmul.argtypes = [
                 ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
                 ctypes.POINTER(ctypes.c_void_p),
                 ctypes.POINTER(ctypes.c_void_p), ctypes.c_size_t,
             ]
             l.gf_matmul.restype = None
+            l.gf_mul_acc.argtypes = [
+                ctypes.c_uint8, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
+            l.gf_mul_acc.restype = None
             l.gf_has_avx2.restype = ctypes.c_int
             # fingerprinted paths make a stale body unreachable, but a
             # hand-copied prebuilt .so could still predate a symbol:
@@ -139,8 +174,8 @@ def lib() -> ctypes.CDLL:
                     ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
                 ]
                 l.reconstruct_and_verify.restype = None
-            _lib = l
-    return _lib
+            _libs[variant] = l
+    return _libs[variant]
 
 
 def _ptr_array(arrs: list[np.ndarray]) -> "ctypes.Array":
@@ -164,6 +199,22 @@ def gf_matmul_cpu(matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
         _ptr_array(in_rows), _ptr_array(out_rows), length,
     )
     return out
+
+
+def gf_mul_acc_cpu(
+    coef: int, src: np.ndarray, dst: np.ndarray
+) -> np.ndarray:
+    """dst ^= coef * src in GF(2^8), native single mul-acc (tests)."""
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    dst = np.ascontiguousarray(dst, dtype=np.uint8)
+    assert src.shape == dst.shape
+    lib().gf_mul_acc(
+        coef,
+        src.ctypes.data_as(ctypes.c_void_p),
+        dst.ctypes.data_as(ctypes.c_void_p),
+        src.shape[0],
+    )
+    return dst
 
 
 def encode_cpu(data: np.ndarray, parity_shards: int) -> np.ndarray:
@@ -328,3 +379,64 @@ def phash256_rows(words: np.ndarray, nbytes: int) -> np.ndarray:
         out.ctypes.data_as(ctypes.c_void_p),
     )
     return out.reshape(*lead, 8)
+
+
+# ---------------------------------------------------------------------
+# Sanitizer harness (MINIO_TPU_SANITIZE=1)
+#
+# The instrumented library cannot be dlopen'd into an uninstrumented
+# CPython: the ASan runtime must be first in the initial library list.
+# The supported recipe is a SUBPROCESS with the env from
+# sanitizer_env(): LD_PRELOAD of the toolchain's libasan plus
+# PYTHONMALLOC=malloc, so ctypes scratch buffers get real redzones
+# instead of hiding inside pymalloc arenas (numpy buffers use malloc
+# either way).  tests/test_native.py's slow sweep drives this.
+# ---------------------------------------------------------------------
+
+
+def asan_runtime_path() -> "str | None":
+    """The toolchain's libasan.so for LD_PRELOAD, or None if absent."""
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout.strip()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    # an unresolved name is echoed back bare, with no directory part
+    if os.path.sep in out and os.path.exists(out):
+        return os.path.realpath(out)
+    return None
+
+
+def sanitizer_env(base: "dict | None" = None) -> "dict[str, str]":
+    """Subprocess env that makes lib() load the instrumented build."""
+    env = dict(os.environ if base is None else base)
+    env["MINIO_TPU_SANITIZE"] = "1"
+    env["PYTHONMALLOC"] = "malloc"
+    rt = asan_runtime_path()
+    if rt:
+        env["LD_PRELOAD"] = rt
+    # leaks are checked explicitly mid-run (lsan_recoverable_leak_check)
+    # - the at-exit sweep would drown in CPython's own still-reachable
+    # allocations under PYTHONMALLOC=malloc
+    env.setdefault("ASAN_OPTIONS", "detect_leaks=1:leak_check_at_exit=0")
+    env.setdefault("UBSAN_OPTIONS", "print_stacktrace=1")
+    return env
+
+
+def lsan_recoverable_leak_check() -> int:
+    """Run LeakSanitizer now; 0 = clean, nonzero = native leaks found.
+
+    Only meaningful inside a sanitizer_env() subprocess; returns 0 when
+    the LSan runtime is not loaded.
+    """
+    try:
+        fn = ctypes.CDLL(None).__lsan_do_recoverable_leak_check
+    except (AttributeError, OSError):
+        return 0
+    fn.restype = ctypes.c_int
+    fn.argtypes = []
+    return int(fn())

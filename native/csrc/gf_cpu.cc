@@ -334,10 +334,17 @@ void for_stripes(int B, int nthreads, F f) {
 
 }  // namespace
 
+// Every export below carries a `// @ctypes name(argtypes...) -> restype`
+// annotation: the intended ctypes signature of its binding in
+// minio_tpu/utils/native.py.  The abi_contracts analysis pass (MTPU4xx)
+// parses these and cross-checks them against both this file's C
+// signatures and the Python bindings, so signature drift on either side
+// of the FFI seam fails the tier-1 gate instead of corrupting memory.
 extern "C" {
 
 // out[r] = XOR_c matrix[r*in_n + c] * in[c], for r in [0, out_n).
 // Each shard is `len` bytes. Out rows are zeroed first.
+// @ctypes gf_matmul(c_int, c_int, c_char_p, POINTER(c_void_p), POINTER(c_void_p), c_size_t) -> None
 void gf_matmul(int out_n, int in_n, const uint8_t* matrix,
                const uint8_t* const* in, uint8_t* const* out, size_t len) {
   for (int r = 0; r < out_n; ++r) {
@@ -349,12 +356,14 @@ void gf_matmul(int out_n, int in_n, const uint8_t* matrix,
 }
 
 // Convenience single mul-acc (used by tests)
+// @ctypes gf_mul_acc(c_uint8, c_void_p, c_void_p, c_size_t) -> None
 void gf_mul_acc(uint8_t c, const uint8_t* in, uint8_t* out, size_t len) {
   mul_acc(c, in, out, len);
 }
 
 // digests[r*8..r*8+8) = phash256 of words[r*nwords..(r+1)*nwords)
 // with the real (unpadded) byte length folded in.
+// @ctypes phash256_rows(c_void_p, c_size_t, c_size_t, c_uint64, c_void_p) -> None
 void phash256_rows(const uint32_t* words, size_t nrows, size_t nwords,
                    uint64_t nbytes, uint32_t* digests) {
   for (size_t r = 0; r < nrows; ++r) {
@@ -370,6 +379,7 @@ void phash256_rows(const uint32_t* words, size_t nrows, size_t nwords,
 //   digests: (B, k+m, 8) uint32 out, data rows then parity
 // L must be a multiple of 32 (the erasure layer's shard padding).
 // Stripes are dispatched over up to nthreads workers.
+// @ctypes encode_and_hash(c_int, c_int, c_int, c_size_t, c_void_p, c_char_p, c_void_p, c_void_p, c_int) -> None
 void encode_and_hash(int B, int k, int m, size_t L, const uint8_t* data,
                      const uint8_t* matrix, uint8_t* parity,
                      uint32_t* digests, int nthreads) {
@@ -384,6 +394,7 @@ void encode_and_hash(int B, int k, int m, size_t L, const uint8_t* data,
 
 // Batched reconstruct: out[b] = rm GF-matmul shards[b][surv], for the
 // whole (B, n, L) batch in one call (pattern uniform across the batch).
+// @ctypes reconstruct_batch(c_int, c_int, c_int, c_size_t, c_void_p, c_void_p, c_char_p, c_void_p, c_int) -> None
 void reconstruct_batch(int B, int n, int k, size_t L, const uint8_t* shards,
                        const int32_t* surv, const uint8_t* rm, uint8_t* out,
                        int nthreads) {
@@ -398,6 +409,7 @@ void reconstruct_batch(int B, int n, int k, size_t L, const uint8_t* shards,
 // each survivor byte once.  ok[b*n+s] = present[s] && digest match.
 // The caller checks ok over `surv` and re-picks survivors on the rare
 // verify failure; L must be a multiple of 4.
+// @ctypes reconstruct_and_verify(c_int, c_int, c_int, c_size_t, c_void_p, c_void_p, c_char_p, c_void_p, c_void_p, c_void_p, c_void_p, c_int) -> None
 void reconstruct_and_verify(int B, int n, int k, size_t L,
                             const uint8_t* shards, const int32_t* surv,
                             const uint8_t* rm, const uint32_t* expect,
@@ -438,6 +450,7 @@ void reconstruct_and_verify(int B, int n, int k, size_t L,
   });
 }
 
+// @ctypes gf_has_avx2() -> c_int
 int gf_has_avx2(void) {
 #if defined(__AVX2__)
   return 1;

@@ -1,0 +1,11 @@
+"""Fixture: arity drift - binding declares fewer args than the export."""
+
+import ctypes
+
+
+def _load():
+    l = ctypes.CDLL("libdemo.so")
+    l.gf_demo_scale.argtypes = [ctypes.c_int, ctypes.c_void_p]  # VIOLATION: MTPU401
+    l.gf_demo_scale.restype = None
+    l.gf_demo_version.restype = ctypes.c_int
+    return l

@@ -1,0 +1,17 @@
+"""Fixture: seeded argtypes/restype drift the ABI checker must catch.
+
+Arity matches the export, so MTPU401 stays quiet: the THIRD argtype is
+c_int where the @ctypes annotation declares c_size_t (a truncation bug
+on 64-bit lengths), and the version probe's restype drifts to c_uint64.
+The checker must report exactly MTPU402 for both.
+"""
+
+import ctypes
+
+
+def _load():
+    l = ctypes.CDLL("libdemo.so")
+    l.gf_demo_scale.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_int]  # VIOLATION: MTPU402
+    l.gf_demo_scale.restype = None
+    l.gf_demo_version.restype = ctypes.c_uint64  # VIOLATION: MTPU402
+    return l

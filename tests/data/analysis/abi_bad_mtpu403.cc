@@ -1,0 +1,17 @@
+// Fixture: an exported symbol nobody binds (pairs with
+// abi_bad_mtpu403.py, which binds a symbol nobody exports).
+#include <stdint.h>
+
+extern "C" {
+
+// @ctypes gf_demo_scale(c_int, c_void_p, c_size_t) -> None
+void gf_demo_scale(int factor, uint8_t* buf, size_t len) {
+  for (size_t i = 0; i < len; ++i) buf[i] = (uint8_t)(buf[i] * factor);
+}
+
+// @ctypes gf_demo_orphan(c_int) -> None
+void gf_demo_orphan(int x) {  // VIOLATION: MTPU403
+  (void)x;
+}
+
+}  // extern "C"

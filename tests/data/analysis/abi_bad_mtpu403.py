@@ -1,0 +1,13 @@
+"""Fixture: a ctypes binding for a symbol the library never exports."""
+
+import ctypes
+
+
+def _load():
+    l = ctypes.CDLL("libdemo.so")
+    l.gf_demo_scale.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+    ]
+    l.gf_demo_scale.restype = None
+    l.gf_demo_ghost.argtypes = [ctypes.c_int]  # VIOLATION: MTPU403
+    return l

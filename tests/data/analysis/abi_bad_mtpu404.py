@@ -1,0 +1,21 @@
+"""Fixture: buffer/length mismatch - the classic ctypes heap overflow.
+
+The pointer comes from ``buf`` but the length is computed from
+``other``; when other is longer than buf the native kernel walks off
+the end of the allocation.
+"""
+
+import ctypes
+
+import numpy as np
+
+
+def _load():
+    return ctypes.CDLL("libdemo.so")
+
+
+def scale_wrong_length(buf, other):
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    n = other.shape[0]
+    _load().gf_demo_scale(2, buf.ctypes.data_as(ctypes.c_void_p), n)  # VIOLATION: MTPU404
+    return buf

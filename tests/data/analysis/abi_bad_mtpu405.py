@@ -1,0 +1,20 @@
+"""Fixture: a raw parameter reaches .ctypes.data_as() unchecked.
+
+A caller handing in a sliced / transposed view makes the native kernel
+read interleaved garbage: the buffer needs np.ascontiguousarray,
+np.require, or a .flags.c_contiguous assert on its def-use chain.
+"""
+
+import ctypes
+
+import numpy as np
+
+
+def _load():
+    return ctypes.CDLL("libdemo.so")
+
+
+def scale_unchecked(buf):
+    n = buf.shape[0]
+    _load().gf_demo_scale(2, buf.ctypes.data_as(ctypes.c_void_p), n)  # VIOLATION: MTPU405
+    return np.asarray(buf)

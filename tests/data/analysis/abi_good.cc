@@ -1,0 +1,17 @@
+// Fixture: clean export table for the ABI contract checker (pairs with
+// abi_good.py; abi_bad_mtpu401/402.py drift against THIS table).
+#include <stddef.h>
+#include <stdint.h>
+
+extern "C" {
+
+// Scales len bytes of buf in place.
+// @ctypes gf_demo_scale(c_int, c_void_p, c_size_t) -> None
+void gf_demo_scale(int factor, uint8_t* buf, size_t len) {
+  for (size_t i = 0; i < len; ++i) buf[i] = (uint8_t)(buf[i] * factor);
+}
+
+// @ctypes gf_demo_version() -> c_int
+int gf_demo_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,24 @@
+"""Fixture: clean ctypes bindings + call sites (pairs with abi_good.cc)."""
+
+import ctypes
+
+import numpy as np
+
+
+def _load():
+    l = ctypes.CDLL("libdemo.so")
+    l.gf_demo_scale.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_size_t,
+    ]
+    l.gf_demo_scale.restype = None
+    l.gf_demo_version.restype = ctypes.c_int
+    return l
+
+
+def scale(buf, factor):
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    n = buf.shape[0]
+    _load().gf_demo_scale(
+        factor, buf.ctypes.data_as(ctypes.c_void_p), n
+    )
+    return buf

@@ -23,12 +23,13 @@ import time
 import pytest
 
 from minio_tpu import analysis
-from minio_tpu.analysis import kernel_contracts
+from minio_tpu.analysis import abi_contracts, kernel_contracts
 from minio_tpu.analysis.findings import (
     RULES,
     Finding,
     filter_suppressed,
     noqa_codes_for_line,
+    unused_suppressions,
 )
 from minio_tpu.analysis.hotpath_lint import lint_source
 from minio_tpu.analysis.lockorder import (
@@ -37,7 +38,8 @@ from minio_tpu.analysis.lockorder import (
 )
 
 FIXTURES = os.path.join(analysis.REPO_ROOT, "tests", "data", "analysis")
-_MARKER_RE = re.compile(r"#\s*VIOLATION:\s*(MTPU\d{3})")
+# fixtures are .py (# comments) or .cc (// comments)
+_MARKER_RE = re.compile(r"(?:#|//)\s*VIOLATION:\s*(MTPU\d{3})")
 
 
 def _fixture_lines(name):
@@ -51,6 +53,30 @@ def _lint_fixture(name, *, rel_path=None):
     rel = rel_path or f"tests/data/analysis/{name}"
     found = lint_source(rel, "\n".join(lines) + "\n")
     return filter_suppressed(found, {rel: lines})
+
+
+def _lint_fixture_with_106(name):
+    """Lint + unused-suppression audit, exactly as run_lint composes."""
+    lines = _fixture_lines(name)
+    rel = f"tests/data/analysis/{name}"
+    text = "\n".join(lines) + "\n"
+    raw = lint_source(rel, text)
+    found = raw + unused_suppressions(rel, text, raw)
+    return filter_suppressed(found, {rel: lines})
+
+
+def _abi_fixture(py_name, cc_name=None):
+    """ABI-check one fixture pair, noqa-filtered on the Python side."""
+    py_lines = _fixture_lines(py_name)
+    py_rel = f"tests/data/analysis/{py_name}"
+    cc_text = cc_rel = None
+    if cc_name is not None:
+        cc_text = "\n".join(_fixture_lines(cc_name)) + "\n"
+        cc_rel = f"tests/data/analysis/{cc_name}"
+    found = abi_contracts.analyze(
+        "\n".join(py_lines) + "\n", py_rel, cc_text, cc_rel
+    )
+    return filter_suppressed(found, {py_rel: py_lines})
 
 
 def _expected_markers(name):
@@ -73,6 +99,13 @@ def test_tree_lint_clean():
 
 def test_lock_builtin_scenario_clean():
     found = analysis.run_locks()
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_tree_abi_clean():
+    """Every native export is bound, every binding matches, no buffer
+    reaches the FFI seam unchecked."""
+    found = analysis.run_abi()
     assert found == [], "\n".join(f.render() for f in found)
 
 
@@ -112,8 +145,13 @@ KNOWN_ENTRY_POINTS = {
 def test_introspection_finds_the_known_entry_points():
     eps = set(kernel_contracts.jit_entry_points())
     assert eps >= KNOWN_ENTRY_POINTS
-    # hash.py intentionally exposes no module-level jitted functions
+    # hash.py intentionally exposes no module-level jitted functions,
+    # and codec/backend.py routes through codec_step's kernels - but
+    # both are WATCHED, so a jitted wrapper landing there without a
+    # contract fails MTPU204 instead of dodging coverage
     assert not any(mod == "hash" for mod, _ in eps)
+    assert "backend" in kernel_contracts._ops_modules()
+    assert not any(mod == "backend" for mod, _ in eps)
 
 
 def test_contract_registry_covers_all_entry_points(contract_findings):
@@ -179,6 +217,153 @@ def test_noqa_parsing():
     assert noqa_codes_for_line(
         "x  # noqa: MTPU103 - logging must never raise"
     ) == {"MTPU103"}
+
+
+# -- MTPU106: unused suppressions ---------------------------------------
+
+
+def test_stale_suppression_is_flagged():
+    expected = _expected_markers("bad_mtpu106.py")
+    got = {
+        (f.rule, f.line) for f in _lint_fixture_with_106("bad_mtpu106.py")
+    }
+    assert got == expected == {("MTPU106", 7)}
+
+
+def test_live_and_deliberate_suppressions_are_clean():
+    found = _lint_fixture_with_106("good_mtpu106.py")
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_unused_suppression_ignores_foreign_and_bare_noqa():
+    src = (
+        "import os  # noqa: F401\n"
+        "x = 1  # noqa\n"
+        "y = os.sep  # noqa: MTPU104\n"
+    )
+    found = unused_suppressions("f.py", src, [])
+    assert [(f.rule, f.line) for f in found] == [("MTPU106", 3)]
+
+
+def test_unused_suppression_skips_docstring_mentions():
+    src = '"""docs say use # noqa: MTPU103 to silence."""\nx = 1\n'
+    assert unused_suppressions("f.py", src, []) == []
+
+
+def test_run_lint_composes_the_suppression_audit():
+    """run_lint feeds the ABI pass's raw findings into the audit: the
+    noqa-free tree stays clean end to end (the stale trace.py
+    suppression this PR pruned would fail here)."""
+    found = [f for f in analysis.run_lint() if f.rule == "MTPU106"]
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+# -- ABI contracts (MTPU401-405): fixture pairs -------------------------
+
+ABI_BAD_FIXTURES = [
+    ("abi_bad_mtpu401.py", "abi_good.cc"),
+    ("abi_bad_mtpu402.py", "abi_good.cc"),
+    ("abi_bad_mtpu403.py", "abi_bad_mtpu403.cc"),
+    ("abi_bad_mtpu404.py", None),
+    ("abi_bad_mtpu405.py", None),
+]
+
+
+def test_abi_good_pair_clean():
+    found = _abi_fixture("abi_good.py", "abi_good.cc")
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+@pytest.mark.parametrize("py_name,cc_name", ABI_BAD_FIXTURES)
+def test_abi_bad_fixture_exact_findings(py_name, cc_name):
+    expected = _expected_markers(py_name)
+    expected |= {
+        (rule, line)
+        for rule, line in (
+            _expected_markers(cc_name) if cc_name else set()
+        )
+    }
+    assert expected, f"{py_name} declares no VIOLATION markers"
+    got = {(f.rule, f.line) for f in _abi_fixture(py_name, cc_name)}
+    assert got == expected
+
+
+def test_seeded_argtypes_drift_fails_with_exactly_mtpu402():
+    """The acceptance fixture: arity matches, types drift - the checker
+    reports MTPU402 and nothing else."""
+    found = _abi_fixture("abi_bad_mtpu402.py", "abi_good.cc")
+    assert found, "drift fixture produced no findings"
+    assert {f.rule for f in found} == {"MTPU402"}
+    assert any("c_size_t" in f.message for f in found)
+
+
+def test_abi_export_parser_reads_the_real_table():
+    with open(
+        os.path.join(analysis.REPO_ROOT, abi_contracts.CC_REL),
+        encoding="utf-8",
+    ) as fh:
+        exports = abi_contracts.parse_exports(fh.read())
+    assert set(exports) >= {
+        "gf_matmul",
+        "gf_mul_acc",
+        "phash256_rows",
+        "encode_and_hash",
+        "reconstruct_batch",
+        "reconstruct_and_verify",
+        "gf_has_avx2",
+    }
+    # every real export must carry a @ctypes annotation - an
+    # unannotated export only gets arity/presence checks
+    for name, exp in exports.items():
+        assert exp.annot_args is not None, f"{name} lacks @ctypes"
+    assert exports["reconstruct_and_verify"].c_arity == 12
+
+
+def test_abi_noqa_suppresses_on_the_python_side():
+    src = (
+        "import ctypes\n"
+        "def f(buf):\n"
+        "    lib = ctypes.CDLL('x.so')\n"
+        "    lib.k(buf.ctypes.data_as(ctypes.c_void_p), 4)"
+        "  # noqa: MTPU405\n"
+    )
+    found = abi_contracts.analyze(src, "f.py")
+    assert [f.rule for f in found] == ["MTPU405"]
+    assert (
+        filter_suppressed(found, {"f.py": src.splitlines()}) == []
+    )
+
+
+# -- directory exclusions are centralized and honored -------------------
+
+
+def test_iter_py_files_prunes_excluded_dirs(tmp_path, monkeypatch):
+    for rel in (
+        "pkg/ok.py",
+        "pkg/__pycache__/junk.py",
+        "native/build/gen.py",
+        "pkg/sub/also_ok.py",
+    ):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("x = 1\n")
+    monkeypatch.setattr(analysis, "REPO_ROOT", str(tmp_path))
+    assert analysis.iter_py_files(["pkg", "native"]) == [
+        "pkg/ok.py",
+        "pkg/sub/also_ok.py",
+    ]
+    # explicitly passing an excluded directory yields nothing
+    assert analysis.iter_py_files(["native/build"]) == []
+    assert analysis.iter_py_files(["pkg/__pycache__"]) == []
+
+
+def test_is_excluded_matches_path_components():
+    assert analysis.is_excluded("native/build/gen.py")
+    assert analysis.is_excluded("a/__pycache__/b.py")
+    assert analysis.is_excluded("minio_tpu/analysis/findings.py")
+    assert not analysis.is_excluded("minio_tpu/utils/native.py")
+    # a FILE named build is not a directory exclusion
+    assert not analysis.is_excluded("minio_tpu/build.py")
 
 
 def test_device_module_rules_are_path_scoped():
@@ -368,9 +553,21 @@ def test_cli_list_rules():
         assert rule in r.stdout
 
 
+def test_cli_skip_covers_the_abi_pass():
+    r = _run_cli("--skip", "abi", "contracts", "locks")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[lint]" in r.stderr
+
+
+def test_cli_changed_only_exits_zero():
+    r = _run_cli("--changed-only", "--skip", "contracts", "locks")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "changed-only" in r.stderr
+
+
 @pytest.mark.slow
 def test_cli_full_run_is_clean():
-    """All three passes through the real CLI (what CI would run)."""
+    """All four passes through the real CLI (what CI would run)."""
     r = _run_cli()
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "0 finding(s) [lint, contracts, locks]" in r.stderr
+    assert "0 finding(s) [lint, abi, contracts, locks]" in r.stderr
