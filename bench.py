@@ -408,6 +408,96 @@ def bench_e2e(
             backend_mod.reset_backend()
 
 
+def bench_get_degraded(
+    obj_mib: int = 4, n_disks: int = 6, reads: int = 30
+) -> dict:
+    """Degraded-path GET micro: healthy vs one-slow-disk tail latency.
+
+    One disk (the holder of shard 1, so always in the preferred read
+    set) is fault-injected at ~20x the pool-median shard-read latency
+    (storage/faults.py); the hedged read loop plus breaker preference
+    (codec/erasure.py, storage/health.py) must hold the degraded p99
+    near the healthy p99 instead of the straggler's latency.  Reported
+    with the hedge launched/won/wasted counters for the degraded phase.
+    """
+    import io
+    import math
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.codec import backend as backend_mod
+    from minio_tpu.codec.telemetry import KERNEL_STATS
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.objectlayer.metadata import hash_order
+    from minio_tpu.storage import health as disk_health
+    from minio_tpu.storage.faults import FaultDisk
+    from minio_tpu.storage.xl import XLStorage
+
+    size = obj_mib << 20
+    root = tempfile.mkdtemp(prefix="minio-tpu-degraded-")
+    saved_env = os.environ.get("MINIO_ERASURE_BACKEND")
+    os.environ["MINIO_ERASURE_BACKEND"] = "cpu"
+    backend_mod.reset_backend()
+    disk_health.reset_registry()
+    try:
+        fds = [
+            FaultDisk(XLStorage(f"{root}/d{i}"), seed=i)
+            for i in range(n_disks)
+        ]
+        ol = ErasureObjects(fds, block_size=BLOCK)
+        ol.make_bucket("bench")
+        payload = np.random.default_rng(11).integers(
+            0, 256, size, dtype=np.uint8
+        ).tobytes()
+        ol.put_object("bench", "obj", io.BytesIO(payload), size)
+
+        def get():
+            t0 = time.perf_counter()
+            ol.get_object("bench", "obj", _NullWriter())
+            return time.perf_counter() - t0
+
+        get()  # warm the all-data fast path
+        slow = hash_order("bench/obj", n_disks).index(1)
+        fds[slow].inject("read_at", error=True)
+        get()  # warm the parity-reconstruct solve (one-time compile)
+        fds[slow].clear()
+
+        healthy = sorted(get() for _ in range(reads))
+        reg = disk_health.registry()
+        delay = max(20.0 * (reg.read_quantile(0.5) or 0.0), 0.02)
+        h0 = KERNEL_STATS.snapshot()["hedge"]
+        fds[slow].inject("read_at", delay_s=delay)
+        degraded = sorted(get() for _ in range(reads))
+        h1 = KERNEL_STATS.snapshot()["hedge"]
+
+        def pct(lats, q):
+            # nearest-rank, honestly including the worst read
+            return lats[max(0, math.ceil(len(lats) * q) - 1)]
+
+        return {
+            "object_mib": obj_mib,
+            "reads_per_phase": reads,
+            "injected_delay_ms": round(delay * 1e3, 2),
+            "healthy_p50_ms": round(pct(healthy, 0.5) * 1e3, 2),
+            "healthy_p99_ms": round(pct(healthy, 0.99) * 1e3, 2),
+            "degraded_p50_ms": round(pct(degraded, 0.5) * 1e3, 2),
+            "degraded_p99_ms": round(pct(degraded, 0.99) * 1e3, 2),
+            "p99_ratio": round(
+                pct(degraded, 0.99) / max(pct(healthy, 0.99), 1e-9), 2
+            ),
+            "hedge": {k: h1[k] - h0.get(k, 0) for k in h1},
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+        disk_health.reset_registry()
+        if saved_env is None:
+            os.environ.pop("MINIO_ERASURE_BACKEND", None)
+        else:
+            os.environ["MINIO_ERASURE_BACKEND"] = saved_env
+        backend_mod.reset_backend()
+
+
 def bench_select_scan() -> dict:
     """S3 Select scan rate over an in-memory CSV
     (pkg/s3select/select_benchmark_test.go shape)."""
@@ -483,9 +573,19 @@ def main() -> None:
         "(EC 8+4, 64 MiB batch) and print its JSON - the kernel win "
         "isolated from e2e noise",
     )
+    ap.add_argument(
+        "--get-degraded",
+        action="store_true",
+        help="run ONLY the degraded-path GET micro (one disk at ~20x "
+        "median read latency; hedged reads + breaker preference hold "
+        "the p99) and print its JSON",
+    )
     args = ap.parse_args()
     if args.codec_micro:
         print(json.dumps(bench_codec_micro(), indent=1))
+        return
+    if args.get_degraded:
+        print(json.dumps(bench_get_degraded(), indent=1))
         return
     if args.no_instrument:
         os.environ["MINIO_TPU_NO_INSTRUMENT"] = "1"

@@ -50,6 +50,8 @@ DEFAULT_TARGETS = (
     "minio_tpu.dsync.namespace",
     "minio_tpu.storage.metered",
     "minio_tpu.storage.diskcheck",
+    "minio_tpu.storage.health",
+    "minio_tpu.storage.faults",
     "minio_tpu.parallel.iopool",
 )
 

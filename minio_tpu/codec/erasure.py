@@ -32,6 +32,7 @@ from . import backend as backend_mod, bitrot, compress
 from .telemetry import KERNEL_STATS
 
 from ..parallel import iopool
+from ..storage import health as disk_health
 from ..utils.log import kv, logger
 
 _log = logger("codec")
@@ -622,7 +623,22 @@ class Erasure:
         iopool (local disks too — 12 spindles seek concurrently) and
         contiguous frames are fetched in one ranged read per shard (one
         RTT per shard per batch, the read twin of the pipelined shard
-        writers)."""
+        writers).
+
+        The escalation loop is hedged and deadline-bounded (the Tail at
+        Scale discipline over the reference's parallelReader shape):
+        outstanding reads race a deadline derived from the pool-wide
+        read p99 (storage/health.py); when the deadline expires with
+        the quorum still short, a duplicate read launches on the next
+        preferred shard instead of blocking on the straggler.  Losers
+        are abandoned — their band slot frees without blocking us — and
+        reported to the straggler's circuit breaker as censored slow
+        samples, so the NEXT GET's preference order already routes
+        around the slow disk.  Suspect/tripped disks sort last among
+        otherwise-equal shards; a straggler that merely lost a hedge
+        race does NOT set the heal flag (slowness is not damage), but
+        observed missing/short/corrupt frames still do.
+        """
         if stages is None:
             stages = {"assemble": 0.0, "codec": 0.0, "disk": 0.0}
         k, m = self.data_blocks, self.parity_blocks
@@ -639,11 +655,22 @@ class Erasure:
         ok = np.zeros((g, n), dtype=bool)
         heal = False
 
+        reg = disk_health.registry()
+        # endpoint per slot: only endpoint-tagged readers (the object
+        # layer stamps disk streams) feed the breakers and estimators;
+        # untagged unit-test doubles read exactly as before
+        endpoints: "dict[int, str | None]" = {}
+        for s in range(n):
+            key = getattr(readers[s], "io_key", None)
+            endpoints[s] = key if isinstance(key, str) else None
+
         def read_shard(s) -> "list[bytes | None]":
             r = readers[s]
             frames: "list[bytes | None]" = [None] * g
             if r is None:
                 return frames
+            ep = endpoints[s]
+            t_read = time.monotonic()
             try:
                 if contiguous:
                     base = self.shard_block_offset(group[0])
@@ -661,65 +688,159 @@ class Erasure:
                             frames[gi] = c
             except Exception:  # noqa: BLE001 - any failure = dead shard
                 readers[s] = None
+                if ep:
+                    reg.record_shard_read(
+                        ep, time.monotonic() - t_read, ok=False
+                    )
                 return [None] * g
+            # service time recorded HERE, on the worker, so the sample
+            # is pure disk latency — settle-side timing would fold in
+            # decode/verify stalls and bias the hedge deadline slow.
+            # An abandoned-but-running read that completes still lands
+            # its true (slow) sample, exactly what the estimator wants.
+            if ep:
+                reg.record_shard_read(
+                    ep, time.monotonic() - t_read, ok=True
+                )
             return frames
 
-        # preference: live readers, local before remote, then natural
-        # order (data shards 0..k-1 first among equals)
+        def slot_state(s: int) -> int:
+            ep = endpoints[s]
+            return reg.get_disk(ep).state() if ep else disk_health.HEALTHY
+
+        # preference: live readers, breaker-healthy before suspect/
+        # tripped, local before remote, then natural order (data shards
+        # 0..k-1 first among equals)
         remaining = sorted(
             (s for s in range(n) if readers[s] is not None),
-            key=lambda s: (not getattr(readers[s], "is_local", True), s),
+            key=lambda s: (
+                slot_state(s),
+                not getattr(readers[s], "is_local", True),
+                s,
+            ),
         )
-        while True:
-            deficit = int(k - ok.sum(axis=1).min()) if g else 0
-            if deficit <= 0:
-                break
-            batch, remaining = remaining[:deficit], remaining[deficit:]
-            if not batch:
-                intact = int(ok.sum(axis=1).min())
-                raise QuorumError(
-                    f"read quorum lost: {intact}/{n} shards intact,"
-                    f" need {k}"
-                )
-            t0 = time.monotonic()
-            results = _fanout_reads(
-                read_shard, batch, readers, frame * g
+        pool = iopool.get_pool()
+        deadline = reg.hedge_deadline()
+        outstanding: "dict[int, tuple]" = {}  # s -> (fut, t0, is_hedge)
+        last_hedge = 0.0
+        hedges = 0
+
+        def launch(hedge: bool) -> None:
+            s = remaining.pop(0)
+            submit = pool.submit_hedged if hedge else pool.submit
+            fut = submit(
+                _io_key(readers[s]),
+                (lambda s=s: read_shard(s)),
+                nbytes=frame * g,
             )
-            stages["disk"] += time.monotonic() - t0
-            t0 = time.monotonic()
-            for s, frames in zip(batch, results):
-                for gi, c in enumerate(frames):
-                    if c is None:
-                        heal = True  # chosen shard missing/short
-                        continue
-                    digests[gi, s] = bitrot.digest_from_bytes(
-                        c[: bitrot.DIGEST_SIZE]
+            outstanding[s] = (fut, time.monotonic(), hedge)
+
+        try:
+            while True:
+                deficit = int(k - ok.sum(axis=1).min()) if g else 0
+                if deficit <= 0:
+                    break
+                while len(outstanding) < deficit and remaining:
+                    launch(hedge=False)
+                if not outstanding:
+                    intact = int(ok.sum(axis=1).min())
+                    raise QuorumError(
+                        f"read quorum lost: {intact}/{n} shards intact,"
+                        f" need {k}"
                     )
-                    shards[gi, s] = np.frombuffer(
-                        c[bitrot.DIGEST_SIZE :], dtype=np.uint8
+                t0 = time.monotonic()
+                # wait for any completion, racing the hedge deadline
+                # (clocked from the oldest outstanding read or the last
+                # hedge, whichever is later — each hedge gets a full
+                # deadline before the next one may fire)
+                timeout = None
+                if (
+                    deadline is not None
+                    and remaining
+                    and hedges < m
+                ):
+                    base = max(
+                        min(v[1] for v in outstanding.values()),
+                        last_hedge,
                     )
-                    present[gi, s] = True
-            results = None  # ranged-read buffers die before verify
-            stages["assemble"] += time.monotonic() - t0
-            # verify only the shards just read: a healthy GET hashes
-            # exactly k columns, and escalation rounds never re-hash
-            # already-verified shards
-            t0 = time.monotonic()
-            bcols = np.asarray(batch)
-            if batch == list(range(batch[0], batch[0] + len(batch))):
-                # contiguous columns (the healthy k-data-shard case):
-                # basic slices give verify views, not 4 MiB temporaries
-                sh_cols = shards[:, batch[0] : batch[0] + len(batch)]
-                dg_cols = digests[:, batch[0] : batch[0] + len(batch)]
-            else:
-                sh_cols = shards[:, bcols]
-                dg_cols = digests[:, bcols]
-            okb = be.verify(sh_cols, dg_cols) & present[:, bcols]
-            sh_cols = dg_cols = None
-            if (okb != present[:, bcols]).any():
-                heal = True  # bitrot detected somewhere
-            ok[:, bcols] = okb
-            stages["codec"] += time.monotonic() - t0
+                    timeout = max(0.0, base + deadline - t0)
+                done = iopool.wait_any(
+                    [v[0] for v in outstanding.values()], timeout
+                )
+                stages["disk"] += time.monotonic() - t0
+                if not done:
+                    # deadline expired, quorum still short: duplicate
+                    # read on the next preferred (parity) shard
+                    launch(hedge=True)
+                    hedges += 1
+                    last_hedge = time.monotonic()
+                    continue
+                # settle every completed slot in one batch
+                batch = sorted(
+                    s for s, v in outstanding.items() if v[0].done()
+                )
+                t0 = time.monotonic()
+                for s in batch:
+                    fut, t_launch, is_hedge = outstanding.pop(s)
+                    frames = fut.result if fut.error is None else None
+                    if frames is None:
+                        frames = [None] * g
+                    got_any = False
+                    for gi, c in enumerate(frames):
+                        if c is None:
+                            heal = True  # chosen shard missing/short
+                            continue
+                        digests[gi, s] = bitrot.digest_from_bytes(
+                            c[: bitrot.DIGEST_SIZE]
+                        )
+                        shards[gi, s] = np.frombuffer(
+                            c[bitrot.DIGEST_SIZE :], dtype=np.uint8
+                        )
+                        present[gi, s] = True
+                        got_any = True
+                    if is_hedge and got_any:
+                        KERNEL_STATS.record_hedge("won")
+                frames = None  # ranged-read buffers die before verify
+                stages["assemble"] += time.monotonic() - t0
+                # verify only the shards just read: a healthy GET
+                # hashes exactly k columns, and escalation rounds never
+                # re-hash already-verified shards
+                t0 = time.monotonic()
+                bcols = np.asarray(batch)
+                if batch == list(
+                    range(batch[0], batch[0] + len(batch))
+                ):
+                    # contiguous columns (the healthy k-data-shard
+                    # case): basic slices give verify views, not 4 MiB
+                    # temporaries
+                    sh_cols = shards[:, batch[0] : batch[0] + len(batch)]
+                    dg_cols = digests[:, batch[0] : batch[0] + len(batch)]
+                else:
+                    sh_cols = shards[:, bcols]
+                    dg_cols = digests[:, bcols]
+                okb = be.verify(sh_cols, dg_cols) & present[:, bcols]
+                sh_cols = dg_cols = None
+                if (okb != present[:, bcols]).any():
+                    heal = True  # bitrot detected somewhere
+                ok[:, bcols] = okb
+                stages["codec"] += time.monotonic() - t0
+        finally:
+            # disavow stragglers: quorum is met (or lost) without them.
+            # Queued losers resolve IopoolAbandoned without running;
+            # running ones finish unobserved.  Their elapsed time is a
+            # CENSORED sample — real latency is at least this — so it
+            # feeds the straggler's slow-strike ladder but never the
+            # latency estimators.
+            now = time.monotonic()
+            for s, (fut, t_launch, is_hedge) in outstanding.items():
+                fut.abandon()
+                ep = endpoints[s]
+                if ep:
+                    reg.record_shard_read(
+                        ep, now - t_launch, ok=True, censored=True
+                    )
+                if is_hedge:
+                    KERNEL_STATS.record_hedge("wasted")
         return shards, ok, heal
 
     # ---- heal (cmd/erasure-lowlevel-heal.go:28-48) ----------------------

@@ -56,6 +56,8 @@ class KernelStats:
         self._iopool: "dict[str, list]" = {}
         self._iopool_depth_hwm = 0
         self._iopool_slowest_s = 0.0
+        # hedged shard reads: kind in {launched, won, wasted}
+        self._hedge: "dict[str, int]" = {}
 
     # -- recording --------------------------------------------------------
 
@@ -111,6 +113,13 @@ class KernelStats:
             if seconds > self._iopool_slowest_s:
                 self._iopool_slowest_s = seconds
 
+    def record_hedge(self, kind: str) -> None:
+        """One hedged-read event: ``launched`` (duplicate read fired),
+        ``won`` (the hedge produced intact shard cells), ``wasted``
+        (abandoned without contributing)."""
+        with self._mu:
+            self._hedge[kind] = self._hedge.get(kind, 0) + 1
+
     def record_io_depth(self, queue: str, depth: int) -> None:
         """Queue depth observed at enqueue (high-water mark only)."""
         with self._mu:
@@ -148,6 +157,10 @@ class KernelStats:
                     )
                 ],
                 "heal_required": self._heal_required,
+                "hedge": {
+                    kind: self._hedge.get(kind, 0)
+                    for kind in ("launched", "won", "wasted")
+                },
                 "stages": [
                     {
                         "op": op,
@@ -188,6 +201,7 @@ class KernelStats:
             self._iopool.clear()
             self._iopool_depth_hwm = 0
             self._iopool_slowest_s = 0.0
+            self._hedge.clear()
 
 
 # Process-wide singleton: one codec seam per process (backend.py caches

@@ -16,7 +16,7 @@ from ..codec import compress as compmod, erasure as ecodec, sse as ssemod
 from ..codec.erasure import Erasure, QuorumError
 from ..parallel import iopool
 from ..parallel.iopool import tag_disk_stream
-from ..storage import errors as serrors
+from ..storage import errors as serrors, health as disk_health
 from ..storage.meta import (
     ErasureInfo,
     FileInfo,
@@ -115,8 +115,22 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         return wq
 
     def _online_disks(self) -> list:
+        """Live disks, with breaker-tripped ones masked to None.
+
+        This is the single choke point every path (GET preference,
+        PUT fan-out ``writers[s]=None`` bookkeeping, metadata quorums,
+        heal) derives its disk list from, so an open circuit breaker
+        (storage/health.py) makes the disk vanish uniformly — zero
+        metered calls reach it — until its backoff admits one probe.
+        """
         return [
-            d if (d is not None and d.is_online()) else None
+            d
+            if (
+                d is not None
+                and not disk_health.should_skip(d)
+                and d.is_online()
+            )
+            else None
             for d in self.disks
         ]
 

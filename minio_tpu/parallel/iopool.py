@@ -50,18 +50,44 @@ def _env_int(name: str, default: int, lo: int, hi: int) -> int:
     return max(lo, min(hi, v))
 
 
+class IopoolTimeout(TimeoutError):
+    """A pool job missed its caller's deadline (the job itself may
+    still be running; see IOFuture.abandon for the disavowal half)."""
+
+
+class IopoolAbandoned(RuntimeError):
+    """A queued job was abandoned before its worker dequeued it — the
+    caller hedged past it and disavowed the result."""
+
+
 class IOFuture:
     """Completion handle for one pool job (result OR error, both kept)."""
 
-    __slots__ = ("_lk", "_event", "_finished", "_cbs", "result", "error")
+    __slots__ = (
+        "_lk", "_event", "_finished", "_cbs", "abandoned", "result", "error"
+    )
 
     def __init__(self):
         self._lk = threading.Lock()
         self._event = threading.Event()
         self._finished = False
         self._cbs: list = []
+        self.abandoned = False
         self.result = None
         self.error: "BaseException | None" = None
+
+    def abandon(self) -> None:
+        """Disavow a hedged-past job: nobody will consume its result.
+
+        Still-queued jobs resolve ``IopoolAbandoned`` at dequeue
+        WITHOUT running — the band slot frees immediately instead of
+        behind a straggling disk.  An already-running job finishes
+        normally (its thread can't be interrupted) and simply resolves
+        unobserved; either way the caller never blocks on it.
+        """
+        with self._lk:
+            if not self._finished:
+                self.abandoned = True
 
     def _resolve(self, result, error: "BaseException | None") -> None:
         with self._lk:
@@ -91,7 +117,9 @@ class IOFuture:
 
     def result_or_raise(self, timeout: "float | None" = None):
         if not self._event.wait(timeout):
-            raise TimeoutError("iopool job did not complete in time")
+            raise IopoolTimeout(
+                f"iopool job did not complete within {timeout}s"
+            )
         if self.error is not None:
             raise self.error
         return self.result
@@ -213,7 +241,27 @@ class IOPool:
             # the next job happens to arrive
             del fut, fn
 
+    def submit_hedged(self, key, fn, nbytes: int = 0) -> IOFuture:
+        """Launch a duplicate/alternate read racing a straggler
+        (first useful result wins; the caller abandons whichever
+        future it stops caring about).  Same ordered-queue semantics
+        as ``submit`` — the hedge targets a DIFFERENT disk's queue, so
+        it never queues behind the straggler it is hedging against.
+        Counted as ``miniotpu_hedge_launched_total``."""
+        try:
+            _kernel_stats().record_hedge("launched")
+        except Exception as exc:  # telemetry must never block a hedge
+            _log.warning("hedge stats failed", extra=kv(err=str(exc)))
+        return self.submit(key, fn, nbytes=nbytes)
+
     def _run_job(self, q, fut, fn, nbytes, depth) -> None:
+        if fut.abandoned:
+            # hedged past while still queued: resolve without running
+            # so the band slot frees now, not behind a straggling disk
+            fut._resolve(
+                None, IopoolAbandoned("job abandoned before dequeue")
+            )
+            return
         t0 = time.monotonic()
         result = None
         error: "BaseException | None" = None
@@ -426,6 +474,29 @@ def fanout(ops, pool: "IOPool | None" = None) -> list:
         fut.wait()
         errs.append(fut.error)
     return errs
+
+
+def wait_any(futs, timeout: "float | None" = None) -> list:
+    """Block until at least one future is finished; return the finished
+    subset (empty list = deadline expired with nothing done).
+
+    This is the hedging loop's clock: ``codec/erasure.py`` waits on its
+    outstanding shard reads with the p99-derived deadline and, when the
+    list comes back empty, launches a duplicate read on the next
+    preferred shard instead of blocking on the straggler.
+    """
+    done = [f for f in futs if f.done()]
+    if done or not futs:
+        return done
+    ev = threading.Event()
+
+    def _wake(_f, _ev=ev):
+        _ev.set()
+
+    for f in futs:
+        f.add_done_callback(_wake)
+    ev.wait(timeout)
+    return [f for f in futs if f.done()]
 
 
 def tag_io_key(obj, key: str) -> None:

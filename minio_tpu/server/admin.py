@@ -462,6 +462,16 @@ class AdminAPI:
                     entry["api_stats"] = stats_fn()
                 except Exception as exc:
                     _log.debug("disk api_stats read failed", extra=kv(err=str(exc)))
+            # circuit-breaker view (storage/health.py): state machine
+            # position, trip/recovery counts, streaming read quantiles
+            h = getattr(d, "health", None)
+            if h is not None:
+                try:
+                    entry["health"] = h.snapshot()
+                except Exception as exc:
+                    _log.debug(
+                        "disk health read failed", extra=kv(err=str(exc))
+                    )
             return entry
 
         local = [

@@ -218,6 +218,7 @@ class Metrics:
         )
 
         self._emit_codec(emit)
+        self._emit_disk_health(emit)
 
         if object_layer is not None:
             disks, usage = _disk_samples(object_layer)
@@ -340,6 +341,22 @@ class Metrics:
             "Decoded streams that reported shards needing heal",
             [({}, snap["heal_required"])],
         )
+        hedge = snap.get("hedge", {})
+        emit(
+            "miniotpu_hedge_launched_total", "counter",
+            "Duplicate shard reads launched past the p99 deadline",
+            [({}, hedge.get("launched", 0))],
+        )
+        emit(
+            "miniotpu_hedge_won_total", "counter",
+            "Hedged reads that delivered intact shard frames",
+            [({}, hedge.get("won", 0))],
+        )
+        emit(
+            "miniotpu_hedge_wasted_total", "counter",
+            "Hedged reads abandoned without contributing",
+            [({}, hedge.get("wasted", 0))],
+        )
         stages = snap["stages"]
         emit(
             "miniotpu_codec_stage_seconds_total", "counter",
@@ -383,9 +400,50 @@ class Metrics:
         )
 
     @staticmethod
+    def _emit_disk_health(emit):
+        """Breaker states + read-latency quantiles (storage/health.py)."""
+        from ..storage import health as disk_health
+
+        reg = disk_health.registry()
+        snap = reg.snapshot()
+        states = reg.states()
+        emit(
+            "miniotpu_disk_state", "gauge",
+            "Circuit-breaker state per disk"
+            " (0=healthy, 1=suspect, 2=tripped)",
+            [
+                ({"disk": ep}, st)
+                for ep, st in sorted(states.items())
+            ],
+        )
+        p99s = [
+            ({"disk": ep}, f'{row["read_p99_seconds"]:.6f}')
+            for ep, row in sorted(snap["disks"].items())
+            if row.get("read_p99_seconds") is not None
+        ]
+        pool_p99 = snap["pool"]["read_p99_seconds"]
+        if pool_p99 is not None:
+            p99s.append(({"disk": "_pool"}, f"{pool_p99:.6f}"))
+        emit(
+            "miniotpu_disk_read_p99_seconds", "gauge",
+            "Streaming p99 of shard-read latency per disk"
+            " (_pool = pool-wide, the hedge-deadline input)",
+            p99s,
+        )
+        emit(
+            "miniotpu_disk_breaker_trips_total", "counter",
+            "Circuit-breaker trips per disk",
+            [
+                ({"disk": ep}, row["trips"])
+                for ep, row in sorted(snap["disks"].items())
+            ],
+        )
+
+    @staticmethod
     def _emit_disk_api(emit, object_layer):
         """Per-disk per-API families from any MeteredDisk in the layer."""
         calls, errors, seconds = [], [], []
+        p99s = []
         for d in _iter_disks(object_layer):
             stats_fn = getattr(d, "api_stats", None)
             if not callable(stats_fn):
@@ -399,6 +457,8 @@ class Metrics:
                 calls.append((kv, row["calls"]))
                 errors.append((kv, row["errors"]))
                 seconds.append((kv, f'{row["seconds"]:.6f}'))
+                if row.get("p99_seconds") is not None:
+                    p99s.append((kv, f'{row["p99_seconds"]:.6f}'))
         emit(
             "miniotpu_disk_api_calls_total", "counter",
             "Storage API calls by disk and API", calls,
@@ -410,6 +470,11 @@ class Metrics:
         emit(
             "miniotpu_disk_api_seconds_total", "counter",
             "Cumulative storage API latency by disk and API", seconds,
+        )
+        emit(
+            "miniotpu_disk_api_p99_seconds", "gauge",
+            "Streaming p99 latency by disk and API (P2 estimator)",
+            p99s,
         )
 
 

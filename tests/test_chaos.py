@@ -1,0 +1,343 @@
+"""Chaos scenarios: the degraded GET path under deterministic faults.
+
+Every scenario drives the REAL stack — ``ErasureObjects`` over
+``MeteredDisk(FaultDisk(XLStorage))`` — so injected latency, errors and
+corruption flow through the production metering ledger, circuit
+breakers and hedged-read loop, not through mocks.  The acceptance
+criteria from the degraded-path work live here:
+
+* one disk at 50x the median shard-read latency keeps GET p99 (over
+  >= 20 reads) within 3x the healthy p99, bit-identical data throughout;
+* a tripped disk is provably skipped — zero metered calls while the
+  breaker is open — then re-admitted by a single successful probe.
+"""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from minio_tpu.codec.telemetry import KERNEL_STATS
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.objectlayer.metadata import hash_order
+from minio_tpu.storage import health as disk_health
+from minio_tpu.storage.faults import FaultDisk, find_fault_disk
+from minio_tpu.storage.xl import XLStorage
+
+BLOCK = 4096
+N_DISKS = 6
+
+
+@pytest.fixture
+def chaos(tmp_path, monkeypatch):
+    """Object layer over fault-injectable disks with a fresh health
+    registry and tightened hedge/breaker knobs (read at registry
+    construction, hence the reset on both sides)."""
+    monkeypatch.setenv("MINIO_TPU_HEDGE_FACTOR", "2")
+    monkeypatch.setenv("MINIO_TPU_HEDGE_MIN_MS", "2")
+    monkeypatch.setenv("MINIO_TPU_BREAKER_BACKOFF_MS", "400")
+    disk_health.reset_registry()
+    fds = [
+        FaultDisk(XLStorage(str(tmp_path / f"disk{i}")), seed=100 + i)
+        for i in range(N_DISKS)
+    ]
+    ol = ErasureObjects(fds, block_size=BLOCK)
+    ol.make_bucket("chaos")
+    yield ol, fds
+    for fd in fds:
+        fd.clear()  # release any parked hangs before teardown
+    disk_health.reset_registry()
+
+
+def _payload(size, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8
+    ).tobytes()
+
+
+def _get(ol, name):
+    buf = io.BytesIO()
+    ol.get_object("chaos", name, buf)
+    return buf.getvalue()
+
+
+def _shard1_disk(name):
+    """Original disk index holding shard 1 — the first data shard, so
+    always in the preferred read set (shuffle_disks places disk i at
+    slot distribution[i]-1)."""
+    return hash_order(f"chaos/{name}", N_DISKS).index(1)
+
+
+def _timed_gets(ol, name, payload, rounds):
+    """GET ``rounds`` times, asserting bit-identical data; returns
+    wall-clock seconds per read."""
+    times = []
+    for _ in range(rounds):
+        t0 = time.monotonic()
+        data = _get(ol, name)
+        times.append(time.monotonic() - t0)
+        assert data == payload
+    return times
+
+
+def _hedge():
+    return KERNEL_STATS.snapshot()["hedge"]
+
+
+# ---- acceptance: tail-latency containment -------------------------------
+
+
+def test_slow_disk_get_p99_within_3x_healthy(chaos):
+    """One disk at 50x the pool-median shard-read latency: hedged reads
+    keep GET p99 over 20 degraded reads within 3x the healthy p99, and
+    every read returns bit-identical data."""
+    ol, fds = chaos
+    payload = _payload(2 * BLOCK + 13, seed=11)
+    ol.put_object("chaos", "accept", io.BytesIO(payload), len(payload))
+
+    # warm the verify kernel's JIT and the pool latency estimator so
+    # the healthy phase measures steady-state reads
+    for _ in range(3):
+        assert _get(ol, "accept") == payload
+    # ... and the parity-reconstruct solve, which healthy reads never
+    # touch: its first-use compile (~90ms) must not be charged to the
+    # degraded phase
+    slow = _shard1_disk("accept")
+    fds[slow].inject("read_at", error=True)
+    assert _get(ol, "accept") == payload
+    fds[slow].clear()
+
+    healthy = _timed_gets(ol, "accept", payload, rounds=30)
+
+    reg = disk_health.registry()
+    p50 = reg.read_quantile(0.5)
+    assert p50 is not None, "healthy phase fed the pool estimator"
+    # 50x median, floored so the straggler always dwarfs the hedge
+    # deadline regardless of how fast the tmpfs reads are
+    delay = max(50.0 * p50, 0.03)
+
+    h0 = _hedge()
+    fds[slow].inject("read_at", delay_s=delay)
+    degraded = _timed_gets(ol, "accept", payload, rounds=20)
+
+    h1 = _hedge()
+    assert h1["launched"] > h0["launched"], "no hedge fired"
+    assert h1["won"] > h0["won"], "hedge never produced the shard"
+
+    healthy_p99 = sorted(healthy)[-1]
+    degraded_p99 = sorted(degraded)[-1]
+    assert degraded_p99 <= 3.0 * healthy_p99, (
+        f"degraded p99 {degraded_p99:.4f}s exceeds 3x healthy "
+        f"p99 {healthy_p99:.4f}s (slow disk {slow}, delay {delay:.4f}s)"
+    )
+
+    # the straggler kept answering (slowly): it must not be flagged for
+    # heal, but the slow-strike ladder must have noticed it
+    snap = reg.snapshot()
+    ep = ol.disks[slow].metered_endpoint()
+    assert snap["disks"][ep]["slow_strikes"] >= 1
+
+
+def test_dead_disk_reads_escalate_to_parity(chaos):
+    """A disk erroring on every API and stream read: GETs stay correct
+    via parity escalation and the failures march the breaker ladder."""
+    ol, fds = chaos
+    payload = _payload(BLOCK + 101, seed=13)
+    ol.put_object("chaos", "dead", io.BytesIO(payload), len(payload))
+    assert _get(ol, "dead") == payload
+
+    victim = _shard1_disk("dead")
+    fds[victim].inject("*", error=True)
+    fds[victim].inject("read_at", error=True)
+
+    for _ in range(4):
+        assert _get(ol, "dead") == payload
+
+    dh = ol.disks[victim].health
+    assert dh.state() != disk_health.HEALTHY
+    assert fds[victim].injected().get("error", 0) > 0
+
+
+def test_bitrot_burst_decodes_and_flags_heal(chaos):
+    """Two of the three data shards corrupted on the wire: bitrot
+    verification rejects them, parity reconstructs bit-identical data,
+    and the object is flagged for healing."""
+    ol, fds = chaos
+    payload = _payload(3 * BLOCK + 7, seed=17)
+    ol.put_object("chaos", "rot", io.BytesIO(payload), len(payload))
+    assert _get(ol, "rot") == payload
+
+    order = hash_order("chaos/rot", N_DISKS)
+    heal0 = KERNEL_STATS.snapshot()["heal_required"]
+    for shard in (1, 2):
+        fds[order.index(shard)].inject("read_at", corrupt=True)
+
+    for _ in range(3):
+        assert _get(ol, "rot") == payload
+    assert KERNEL_STATS.snapshot()["heal_required"] > heal0
+
+
+# ---- acceptance: breaker trip / skip / re-admission ---------------------
+
+
+def test_breaker_trip_skips_disk_then_probe_readmits(chaos):
+    """Trip a disk through real failing calls, prove the open breaker
+    short-circuits it before ANY metered call, then lift the fault and
+    watch one probe re-admit it."""
+    ol, fds = chaos
+    payload = _payload(BLOCK + 7, seed=23)
+    ol.put_object("chaos", "trip", io.BytesIO(payload), len(payload))
+    assert _get(ol, "trip") == payload
+
+    victim = _shard1_disk("trip")
+    md = ol.disks[victim]
+    dh = md.health
+    fds[victim].inject("*", error=True)
+    fds[victim].inject("read_at", error=True)
+
+    for _ in range(12):
+        assert _get(ol, "trip") == payload
+        if dh.state() == disk_health.TRIPPED:
+            break
+    assert dh.state() == disk_health.TRIPPED
+
+    # while open: _online_disks's should_skip() short-circuits before
+    # is_online(), so the ledger must not move at all
+    stats_open = md.api_stats()
+    for _ in range(5):
+        assert _get(ol, "trip") == payload
+    assert md.api_stats() == stats_open, (
+        "metered calls reached a tripped disk"
+    )
+
+    # lift the fault, let the 400ms backoff lapse, and read: admit()
+    # grants a single probe whose success closes the breaker
+    fds[victim].clear()
+    time.sleep(0.5)
+    assert _get(ol, "trip") == payload
+    assert dh.state() == disk_health.HEALTHY
+    assert dh.recoveries >= 1
+    calls = lambda st: sum(r["calls"] for r in st.values())  # noqa: E731
+    assert calls(md.api_stats()) > calls(stats_open)
+
+
+def test_find_fault_disk_reaches_through_wrap_chain(chaos):
+    ol, fds = chaos
+    for i, d in enumerate(ol.disks):
+        assert find_fault_disk(d) is fds[i]
+
+
+# ---- long schedules: flapping and wedged disks --------------------------
+
+
+@pytest.mark.slow
+def test_flapping_disk_trips_and_recovers_repeatedly(chaos):
+    """Error burst -> trip -> fault lifted -> probe recovery, twice.
+    Data stays bit-identical through every phase and the breaker logs
+    each excursion."""
+    ol, fds = chaos
+    payload = _payload(2 * BLOCK + 3, seed=29)
+    ol.put_object("chaos", "flap", io.BytesIO(payload), len(payload))
+    assert _get(ol, "flap") == payload
+
+    victim = _shard1_disk("flap")
+    dh = ol.disks[victim].health
+
+    for cycle in range(2):
+        fds[victim].inject("*", error=True)
+        fds[victim].inject("read_at", error=True)
+        for _ in range(12):
+            assert _get(ol, "flap") == payload
+            if dh.state() == disk_health.TRIPPED:
+                break
+        assert dh.state() == disk_health.TRIPPED, f"cycle {cycle}"
+
+        fds[victim].clear()
+        # backoff doubles per failed probe; none fail here, so one
+        # base backoff is enough
+        time.sleep(0.5)
+        assert _get(ol, "flap") == payload
+        assert dh.state() == disk_health.HEALTHY, f"cycle {cycle}"
+
+    assert dh.trips >= 2
+    assert dh.recoveries >= 2
+
+
+@pytest.mark.slow
+def test_wedged_disk_is_hedged_past_not_waited_on(chaos):
+    """A disk that parks read_at on an event (wedged, not failing):
+    the hedge deadline abandons it, parity answers, and the GET
+    completes orders of magnitude before the hang would release."""
+    ol, fds = chaos
+    payload = _payload(BLOCK + 31, seed=31)
+    ol.put_object("chaos", "hang", io.BytesIO(payload), len(payload))
+    # prime the pool estimator: the hedge deadline needs p99 samples
+    for _ in range(5):
+        assert _get(ol, "hang") == payload
+
+    victim = _shard1_disk("hang")
+    fds[victim].inject("read_at", hang_s=30.0)
+
+    t0 = time.monotonic()
+    assert _get(ol, "hang") == payload
+    wall = time.monotonic() - t0
+    assert wall < 5.0, f"GET waited on a wedged disk ({wall:.1f}s)"
+    # fixture teardown clear() releases the parked worker
+
+
+# ---- lock discipline under chaos ----------------------------------------
+
+
+def test_lockorder_clean_under_concurrent_chaos(tmp_path, monkeypatch):
+    """The MTPU3xx auditor installed over concurrent GETs against a
+    fault-injected set: health registry, breakers, fault schedules and
+    the metered ledger must stay acyclic and sleep-clean."""
+    from minio_tpu.analysis.lockorder import LockOrderAuditor
+
+    monkeypatch.setenv("MINIO_TPU_HEDGE_MIN_MS", "2")
+    aud = LockOrderAuditor()
+    with aud.installed():
+        # everything constructed inside the audited scope so the
+        # health/faults/metered locks are the audited primitives
+        disk_health.reset_registry()
+        fds = [
+            FaultDisk(XLStorage(str(tmp_path / f"cd{i}")), seed=7 + i)
+            for i in range(N_DISKS)
+        ]
+        ol = ErasureObjects(fds, block_size=BLOCK)
+        ol.make_bucket("chaos")
+        payload = _payload(2 * BLOCK + 9, seed=37)
+        ol.put_object(
+            "chaos", "lk", io.BytesIO(payload), len(payload)
+        )
+        assert _get(ol, "lk") == payload
+        fds[_shard1_disk("lk")].inject(
+            "read_at", delay_s=0.005, prob=0.5
+        )
+
+        errs: "list[BaseException]" = []
+
+        def worker():
+            try:
+                for _ in range(6):
+                    assert _get(ol, "lk") == payload
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker) for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        for fd in fds:
+            fd.clear()
+        disk_health.reset_registry()
+
+    assert not errs, errs
+    findings = aud.report()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert aud.edge_labels(), "auditor observed no nested acquisitions"

@@ -159,3 +159,179 @@ def test_dynamic_timeout_stable_in_between():
         else:
             dt.log_success(1.0)
     assert dt.timeout == pytest.approx(10.0)
+
+
+# ---- escalation matrix: bitrot x slow-disk x exhaustion ----------------
+#
+# These drive codec/erasure.py's hedged quorum loop directly over
+# in-memory shards (tests/test_erasure.py doubles) so each cell of the
+# matrix is deterministic: latency is injected per reader, bitrot by
+# flipping stored bytes, and the hedge deadline is seeded through the
+# health registry instead of waiting for organic warmup.
+
+
+import threading
+import time
+
+import numpy as np
+
+from minio_tpu.codec.erasure import Erasure, QuorumError
+from minio_tpu.codec.telemetry import KERNEL_STATS
+from minio_tpu.parallel import iopool
+from minio_tpu.storage import health as disk_health
+
+from tests.test_erasure import MemShard
+
+
+class _SlowShard(MemShard):
+    """read_at stalls; the straggler the hedge must route around."""
+
+    def __init__(self, delay_s):
+        super().__init__()
+        self.delay_s = delay_s
+
+    def read_at(self, off, length):
+        time.sleep(self.delay_s)
+        return super().read_at(off, length)
+
+
+def _seed_pool_latency(reg, endpoint="warm", seconds=0.0005, n=30):
+    """Warm the pool-wide read estimator so hedge_deadline() is live
+    (clamped to MINIO_TPU_HEDGE_MIN_MS, 2ms by default)."""
+    for _ in range(n):
+        reg.record_shard_read(endpoint, seconds, ok=True)
+
+
+def _encode(er, payload, n):
+    shards = [MemShard() for _ in range(n)]
+    er.encode(io.BytesIO(payload), list(shards), write_quorum=n - 1)
+    return shards
+
+
+def _rng_payload(size, seed=5):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8
+    ).tobytes()
+
+
+def _warm_decode(er, payload, n):
+    """One healthy decode on clean shards: warms the verify kernel
+    (first-call JIT would otherwise dwarf the injected delays) and
+    feeds the pool read estimator real sub-ms samples."""
+    clean = _encode(er, payload, n)
+    for i, r in enumerate(clean):
+        iopool.tag_io_key(r, f"warm-clean-{i}")
+    out = io.BytesIO()
+    er.decode(out, list(clean), 0, len(payload), len(payload))
+    assert out.getvalue() == payload
+
+
+def test_bitrot_plus_slow_disk_in_one_round(tmp_path):
+    """One round faces BOTH failure modes at once: data shard 0 is
+    slow AND corrupt, data shard 1 healthy, parity slower still.  The
+    deadline hedges onto parity, the corrupt straggler lands mid-round
+    and fails verify, parity completes the quorum — bytes come back
+    bit-identical, heal_required fires (bitrot was OBSERVED, the hedge
+    win must not mask it), and the hedge telemetry shows a win."""
+    disk_health.reset_registry()
+    k, m, bs = 2, 2, 2048
+    n = k + m
+    er = Erasure(k, m, bs)
+    payload = _rng_payload(bs)  # single block: one group, one round
+    shards = _encode(er, payload, n)
+    _warm_decode(er, payload, n)
+
+    # shard 0: corrupt one byte inside the stored frame's data region
+    shards[0].buf[40] ^= 0xFF
+    slow0 = _SlowShard(0.03)
+    slow0.buf = shards[0].buf
+    par2, par3 = _SlowShard(0.06), _SlowShard(0.06)
+    par2.buf, par3.buf = shards[2].buf, shards[3].buf
+    readers = [slow0, shards[1], par2, par3]
+    for i, r in enumerate(readers):
+        iopool.tag_io_key(r, f"matrix-a-{i}")
+
+    reg = disk_health.registry()
+    _seed_pool_latency(reg)
+    assert reg.hedge_deadline() is not None
+    hedge0 = KERNEL_STATS.snapshot()["hedge"]
+
+    out = io.BytesIO()
+    written, heal = er.decode(out, readers, 0, len(payload), len(payload))
+    assert written == len(payload)
+    assert out.getvalue() == payload
+    assert heal, "observed bitrot must set heal even when a hedge won"
+    hedge1 = KERNEL_STATS.snapshot()["hedge"]
+    assert hedge1["launched"] > hedge0["launched"]
+    assert hedge1["won"] > hedge0["won"]
+    disk_health.reset_registry()
+
+
+def test_hedge_win_masking_slow_but_clean_shard_sets_no_heal(tmp_path):
+    """The complement: a shard that is merely SLOW (clean bytes) loses
+    the hedge race — losing on time is not damage, so heal stays
+    unset and the loser is reported as a censored slow sample."""
+    disk_health.reset_registry()
+    k, m, bs = 2, 2, 2048
+    n = k + m
+    er = Erasure(k, m, bs)
+    payload = _rng_payload(bs, seed=6)
+    shards = _encode(er, payload, n)
+    _warm_decode(er, payload, n)
+    slow0 = _SlowShard(0.25)
+    slow0.buf = shards[0].buf
+    readers = [slow0, shards[1], shards[2], shards[3]]
+    for i, r in enumerate(readers):
+        iopool.tag_io_key(r, f"matrix-b-{i}")
+    reg = disk_health.registry()
+    _seed_pool_latency(reg)
+
+    out = io.BytesIO()
+    t0 = time.monotonic()
+    written, heal = er.decode(out, readers, 0, len(payload), len(payload))
+    wall = time.monotonic() - t0
+    assert out.getvalue() == payload
+    assert not heal, "a slow-but-clean straggler is not damage"
+    assert wall < 0.2, f"hedge should beat the 250ms straggler ({wall:.3f}s)"
+    # the straggler's breaker saw the censored sample
+    assert reg.get_disk("matrix-b-0").snapshot()["slow_strikes"] >= 1
+    disk_health.reset_registry()
+
+
+def test_escalation_exhaustion_raises_not_hangs(tmp_path):
+    """Below read quorum the loop must fail FAST with the canonical
+    QuorumError, never wait out deadlines on shards that do not
+    exist."""
+    disk_health.reset_registry()
+    k, m, bs = 2, 2, 2048
+    n = k + m
+    er = Erasure(k, m, bs)
+    payload = _rng_payload(bs, seed=7)
+    shards = _encode(er, payload, n)
+    # three dead disks: only one live shard < k
+    readers = [None, shards[1], None, None]
+    t0 = time.monotonic()
+    with pytest.raises(QuorumError, match="read quorum lost"):
+        er.decode(io.BytesIO(), readers, 0, len(payload), len(payload))
+    assert time.monotonic() - t0 < 5.0
+    disk_health.reset_registry()
+
+
+def test_escalation_exhaustion_with_bitrot_everywhere(tmp_path):
+    """k-1 intact shards + corrupt everywhere else: escalation reads
+    every shard, verify rejects the rot, and the loop terminates in
+    QuorumError instead of spinning on an empty preference list."""
+    disk_health.reset_registry()
+    k, m, bs = 2, 2, 2048
+    n = k + m
+    er = Erasure(k, m, bs)
+    payload = _rng_payload(bs, seed=8)
+    shards = _encode(er, payload, n)
+    for s in (0, 2, 3):  # corrupt all but one shard
+        shards[s].buf[50] ^= 0xFF
+    readers = list(shards)
+    t0 = time.monotonic()
+    with pytest.raises(QuorumError, match="read quorum lost"):
+        er.decode(io.BytesIO(), readers, 0, len(payload), len(payload))
+    assert time.monotonic() - t0 < 5.0
+    disk_health.reset_registry()

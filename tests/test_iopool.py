@@ -271,3 +271,96 @@ def test_parallel_writes_beat_sequential():
         f"parallel {parallel:.3f}s not faster than "
         f"sequential {sequential:.3f}s"
     )
+
+
+# ---- hedging primitives (IopoolTimeout / abandon / wait_any) -----------
+
+
+def test_result_or_raise_timeout_is_distinct_type():
+    """Callers race pool futures against deadlines; a timeout must be
+    distinguishable from a job that itself raised TimeoutError."""
+    pool = iopool.IOPool(queues=1, depth=4, name_prefix="iopool-t")
+    try:
+        gate = threading.Event()
+        fut = pool.submit("d0", gate.wait)
+        with pytest.raises(iopool.IopoolTimeout):
+            fut.result_or_raise(timeout=0.02)
+        assert isinstance(
+            iopool.IopoolTimeout("x"), TimeoutError
+        )  # still catchable as the stdlib family
+        gate.set()
+        assert fut.result_or_raise(timeout=10) is True
+    finally:
+        gate.set()
+        pool.shutdown()
+
+
+def test_abandoned_queued_job_never_runs_and_frees_the_slot():
+    """A hedge loser abandoned while still queued must resolve without
+    executing, and the band slot it held must free immediately — not
+    behind the straggler it lost to."""
+    pool = iopool.IOPool(queues=1, depth=2, name_prefix="iopool-t")
+    try:
+        gate = threading.Event()
+        ran = []
+        straggler = pool.submit("d0", gate.wait)
+        loser = pool.submit("d0", lambda: ran.append(1))
+        loser.abandon()
+        assert loser.abandoned
+        gate.set()
+        straggler.wait(10)
+        assert loser.wait(10)
+        assert not ran, "abandoned job must not execute"
+        assert isinstance(loser.error, iopool.IopoolAbandoned)
+        # the freed slot admits new work promptly (depth=2 queue was
+        # holding the loser; a wedged slot would block this submit)
+        t0 = time.monotonic()
+        assert pool.submit("d0", lambda: 7).result_or_raise(5) == 7
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        gate.set()
+        pool.shutdown()
+
+
+def test_abandon_after_completion_is_a_noop():
+    pool = iopool.IOPool(queues=1, depth=4, name_prefix="iopool-t")
+    try:
+        fut = pool.submit("d0", lambda: 42)
+        assert fut.result_or_raise(10) == 42
+        fut.abandon()
+        assert not fut.abandoned  # finished futures stay unabandoned
+        assert fut.result == 42 and fut.error is None
+    finally:
+        pool.shutdown()
+
+
+def test_wait_any_returns_done_subset_or_empty_on_deadline():
+    # queues=4 -> 3 main-band queues, so d0/d1 get separate workers
+    pool = iopool.IOPool(queues=4, depth=4, name_prefix="iopool-t")
+    try:
+        gate = threading.Event()
+        slow = pool.submit("d0", gate.wait)
+        fast = pool.submit("d1", lambda: "ok")
+        done = iopool.wait_any([slow, fast], timeout=5)
+        assert fast in done and slow not in done
+        assert iopool.wait_any([slow], timeout=0.02) == []
+        gate.set()
+        assert iopool.wait_any([slow], timeout=5) == [slow]
+        assert iopool.wait_any([], timeout=0.01) == []
+    finally:
+        gate.set()
+        pool.shutdown()
+
+
+def test_submit_hedged_counts_launches():
+    from minio_tpu.codec.telemetry import KERNEL_STATS
+
+    pool = iopool.IOPool(queues=2, depth=4, name_prefix="iopool-t")
+    before = KERNEL_STATS.snapshot()["hedge"]["launched"]
+    try:
+        fut = pool.submit_hedged("d1", lambda: b"frame")
+        assert fut.result_or_raise(10) == b"frame"
+    finally:
+        pool.shutdown()
+    after = KERNEL_STATS.snapshot()["hedge"]["launched"]
+    assert after == before + 1

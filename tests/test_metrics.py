@@ -231,12 +231,18 @@ def test_metered_disk_ledger(tmp_path):
     with pytest.raises(Exception):
         d.read_all("vol", "nope")
     stats = d.api_stats()
-    assert stats["write_all"] == pytest.approx(
-        {"calls": 1, "errors": 0, "seconds": stats["write_all"]["seconds"]}
-    )
+    assert stats["write_all"]["calls"] == 1
+    assert stats["write_all"]["errors"] == 0
+    assert stats["write_all"]["seconds"] > 0
     assert stats["read_all"]["calls"] == 2
     assert stats["read_all"]["errors"] == 1
     assert stats["read_all"]["seconds"] > 0
+    # streaming quantiles ride along (successful calls only)
+    assert stats["read_all"]["p50_seconds"] > 0
+    assert stats["read_all"]["p99_seconds"] >= stats["read_all"]["p50_seconds"]
+    assert d.api_p99("read_all") == pytest.approx(
+        stats["read_all"]["p99_seconds"], abs=1e-6
+    )
     # unmetered passthrough still works (root, endpoint, is_online)
     assert d.root == str(tmp_path / "md")
     assert d.is_online()
