@@ -498,6 +498,215 @@ def bench_get_degraded(
         backend_mod.reset_backend()
 
 
+def bench_put_readback(
+    obj_mib: int = 4, n_disks: int = 6, puts: int = 8
+) -> dict:
+    """Device-resident parity plane micro: PUT-ack readback accounting.
+
+    Two runs of the same PUTs through the real object layer on the
+    device codec (EC 4+2, single-device mesh so parity planes stay
+    cached on device):
+
+      legacy       MINIO_TPU_PARITY_PLANE=off - parity is read back
+                   eagerly inside encode_end, before the ack.
+      plane_early  MINIO_TPU_PARITY_PLANE=on + MINIO_TPU_PARITY_ACK=
+                   early - encode returns 32-byte digests only; parity
+                   D2H rides the background band past the data-quorum
+                   ack.
+
+    The miniotpu_codec_d2h_bytes_total{plane} counters are snapshotted
+    at the ack (last put_object return) and again once the parity cache
+    has fully drained.  Because the band drains parity CONCURRENTLY
+    with the data-shard fsyncs, wall-clock snapshots alone cannot tell
+    "the ack waited on this transfer" from "the band happened to finish
+    first" on fast local disks - so the bench additionally splits every
+    parity D2H by the thread that performed it: transfers on iopool
+    workers are band drains the ack never blocks on; transfers on the
+    caller/batcher threads sit on the ack critical path (legacy
+    encode_end reads parity back there).  `parity_d2h_by_path` is the
+    tentpole metric: ack_path bytes drop to 0 on the plane path.
+
+    Both runs write the same object names into separate roots; the
+    on-disk shard part files are compared byte-for-byte at the end
+    (bit-identity is a hard acceptance gate, not a sampled check).
+    """
+    import glob as globmod
+    import io
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from minio_tpu.codec import backend as backend_mod
+    from minio_tpu.codec.telemetry import KERNEL_STATS
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.storage.xl import XLStorage
+
+    size = obj_mib << 20
+    payload = np.random.default_rng(17).integers(
+        0, 256, size, dtype=np.uint8
+    ).tobytes()
+    saved = {
+        k: os.environ.get(k)
+        for k in (
+            "MINIO_ERASURE_BACKEND",
+            "MINIO_MESH",
+            "MINIO_TPU_PARITY_PLANE",
+            "MINIO_TPU_PARITY_ACK",
+        )
+    }
+    os.environ["MINIO_ERASURE_BACKEND"] = "tpu"
+    os.environ["MINIO_MESH"] = "0"
+
+    def _d2h(snap):
+        return {
+            row["plane"]: row["bytes"] for row in snap.get("d2h", [])
+        }
+
+    def _delta(before, after):
+        return {
+            plane: after.get(plane, 0) - before.get(plane, 0)
+            for plane in ("data", "parity")
+        }
+
+    def _shard_parts(root):
+        """{relative part path: bytes} across all disks (xl.meta
+        excluded - it embeds mod_time)."""
+        out = {}
+        for p in sorted(
+            globmod.glob(f"{root}/d*/bench/**/part.*", recursive=True)
+        ):
+            rel = os.path.relpath(p, root)
+            # strip the minted uuid data_dir segment for cross-run keys
+            parts = rel.split(os.sep)
+            rel = os.sep.join(parts[:3] + parts[4:])
+            with open(p, "rb") as f:
+                out[rel] = f.read()
+        return out
+
+    def _run(plane_on):
+        os.environ["MINIO_TPU_PARITY_PLANE"] = (
+            "on" if plane_on else "off"
+        )
+        os.environ["MINIO_TPU_PARITY_ACK"] = (
+            "early" if plane_on else "settle"
+        )
+        backend_mod.reset_backend()
+        root = tempfile.mkdtemp(prefix="minio-tpu-readback-")
+        disks = [XLStorage(f"{root}/d{i}") for i in range(n_disks)]
+        ol = ErasureObjects(disks, parity_blocks=2, block_size=BLOCK)
+        ol.make_bucket("bench")
+
+        def put(key):
+            t0 = time.perf_counter()
+            ol.put_object("bench", key, io.BytesIO(payload), size)
+            return time.perf_counter() - t0
+
+        put("warm")  # compile + page in
+
+        def _settled():
+            """Parity cache empty AND the d2h counters quiet."""
+            deadline = time.monotonic() + 30.0
+            last = None
+            while time.monotonic() < deadline:
+                snap = KERNEL_STATS.snapshot()
+                cur = (
+                    snap["parity_cache"]["entries"],
+                    _d2h(snap).get("parity", 0),
+                )
+                if cur == last and cur[0] == 0:
+                    return snap
+                last = cur
+                time.sleep(0.05)
+            return KERNEL_STATS.snapshot()
+
+        _settled()  # flush the warm put's band before measuring
+        # causal split: tee every parity D2H by the thread that ran it
+        by_path = {"ack_path": 0, "band": 0}
+        tee_mu = threading.Lock()
+        real_record = backend_mod._record_d2h
+
+        def tee(plane, nbytes):
+            real_record(plane, nbytes)
+            if plane == "parity":
+                where = (
+                    "band"
+                    if threading.current_thread().name.startswith(
+                        "iopool"
+                    )
+                    else "ack_path"
+                )
+                with tee_mu:
+                    by_path[where] += int(nbytes)
+
+        before = _d2h(KERNEL_STATS.snapshot())
+        backend_mod._record_d2h = tee
+        try:
+            lats = [put(f"o{i}") for i in range(puts)]
+            at_ack = _d2h(KERNEL_STATS.snapshot())
+            t0 = time.monotonic()
+            settled_snap = _settled()
+        finally:
+            backend_mod._record_d2h = real_record
+        settle_wait = time.monotonic() - t0
+        settled = _d2h(settled_snap)
+        return {
+            "root": root,
+            "put_ack_p50_ms": round(
+                statistics.median(lats) * 1e3, 1
+            ),
+            "d2h_at_ack": _delta(before, at_ack),
+            "d2h_settled": _delta(before, settled),
+            "parity_d2h_by_path": dict(by_path),
+            "settle_wait_ms": round(settle_wait * 1e3, 1),
+        }
+
+    try:
+        legacy = _run(plane_on=False)
+        early = _run(plane_on=True)
+        identical = _shard_parts(legacy["root"]) == _shard_parts(
+            early["root"]
+        )
+        data_bytes = puts * size
+        return {
+            "object_mib": obj_mib,
+            "puts": puts,
+            "ec": f"{n_disks - 2}+2",
+            "legacy": {
+                k: v for k, v in legacy.items() if k != "root"
+            },
+            "plane_early": {
+                k: v for k, v in early.items() if k != "root"
+            },
+            # parity bytes read back ON the ack critical path, per byte
+            # of object data (the tentpole metric: 0 on the plane path)
+            "ack_path_parity_d2h_per_data_byte": {
+                "legacy": round(
+                    legacy["parity_d2h_by_path"]["ack_path"]
+                    / data_bytes,
+                    4,
+                ),
+                "plane_early": round(
+                    early["parity_d2h_by_path"]["ack_path"]
+                    / data_bytes,
+                    4,
+                ),
+            },
+            "shards_bit_identical": identical,
+        }
+    finally:
+        for r in ("legacy", "early"):
+            v = locals().get(r)
+            if isinstance(v, dict) and "root" in v:
+                shutil.rmtree(v["root"], ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        backend_mod.reset_backend()
+
+
 def bench_select_scan() -> dict:
     """S3 Select scan rate over an in-memory CSV
     (pkg/s3select/select_benchmark_test.go shape)."""
@@ -580,12 +789,22 @@ def main() -> None:
         "median read latency; hedged reads + breaker preference hold "
         "the p99) and print its JSON",
     )
+    ap.add_argument(
+        "--put-readback",
+        action="store_true",
+        help="run ONLY the device-resident parity plane micro (PUT-ack "
+        "D2H byte accounting, legacy vs digest-only + quorum-early "
+        "drain, on-disk shard bit-identity) and print its JSON",
+    )
     args = ap.parse_args()
     if args.codec_micro:
         print(json.dumps(bench_codec_micro(), indent=1))
         return
     if args.get_degraded:
         print(json.dumps(bench_get_degraded(), indent=1))
+        return
+    if args.put_readback:
+        print(json.dumps(bench_put_readback(), indent=1))
         return
     if args.no_instrument:
         os.environ["MINIO_TPU_NO_INSTRUMENT"] = "1"

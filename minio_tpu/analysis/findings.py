@@ -44,6 +44,12 @@ RULES: "dict[str, str]" = {
         "fire on that line (stale suppressions rot; silence MTPU106 "
         "itself on the line to keep one deliberately)"
     ),
+    "MTPU107": (
+        "eager parity readback: np.asarray/np.array/jax.device_get of a "
+        "device parity output outside the *_end/drain seams in "
+        "minio_tpu/ops or codec/backend.py (re-introduces the D2H "
+        "round-trip the digest-only PUT path removed)"
+    ),
     "MTPU201": "kernel contract: wrong output dtype from a jitted entry point",
     "MTPU202": "kernel contract: wrong output shape from a jitted entry point",
     "MTPU203": (

@@ -45,6 +45,15 @@ _SYNC_ATTRS = {"block_until_ready", "item"}
 _NP_MATERIALIZE = {"asarray", "array", "ascontiguousarray", "frombuffer"}
 _SCALAR_ANNOTATIONS = {"int", "str", "bool", "bytes", "float", "tuple", "Tuple"}
 
+# MTPU107: eager readback of device parity outputs.  Parity produced on
+# device must cross D2H only through the sanctioned seams (encode_end /
+# the ParityRef drain path) — an np.asarray/np.array/jax.device_get of
+# a parity value anywhere else in the kernel modules or the backend
+# re-introduces the eager round-trip the digest-only PUT removed.
+_PARITY_SCOPE_PREFIXES = ("minio_tpu/ops/",)
+_PARITY_SCOPE_FILES = ("minio_tpu/codec/backend.py",)
+_PARITY_SEAM_RE = re.compile(r"(_end$|drain)")
+
 _METRIC_NAME_RE = re.compile(r"^miniotpu_[a-z0-9_]+$")
 _LABEL_KEY_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 _METRIC_TYPES = {"counter", "gauge", "histogram"}
@@ -138,6 +147,10 @@ class _Linter(ast.NodeVisitor):
     def __init__(self, rel_path: str):
         self.rel_path = rel_path
         self.device_module = rel_path.startswith(DEVICE_ONLY_PREFIXES)
+        self.parity_scope = (
+            rel_path.startswith(_PARITY_SCOPE_PREFIXES)
+            or rel_path in _PARITY_SCOPE_FILES
+        )
         self.findings: "list[Finding]" = []
         # stack of (func_name, jit_static_names or None)
         self._funcs: "list[tuple[str, set | None]]" = []
@@ -205,8 +218,41 @@ class _Linter(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         self._check_sync(node)
+        self._check_parity_readback(node)
         self._check_metric_emit(node)
         self.generic_visit(node)
+
+    def _check_parity_readback(self, node: ast.Call) -> None:
+        """MTPU107: eager parity D2H outside the *_end/drain seams."""
+        if not self.parity_scope or not node.args:
+            return
+        if self._in_host_boundary() or any(
+            _PARITY_SEAM_RE.search(name) for name, _ in self._funcs
+        ):
+            return
+        dotted = _dotted(node.func) or ""
+        attr = (
+            node.func.attr
+            if isinstance(node.func, ast.Attribute)
+            else dotted
+        )
+        eager = dotted in ("jax.device_get", "device_get") or (
+            dotted.startswith(("np.", "numpy."))
+            and attr in ("asarray", "array")
+        )
+        if not eager:
+            return
+        root = _root_name(node.args[0])
+        if root is None or not (root == "par" or "parity" in root):
+            return
+        self._emit(
+            "MTPU107",
+            node,
+            f"{dotted}({root}...) eagerly reads device parity back to "
+            "host outside the *_end/drain seams; keep the plane "
+            "device-resident and route readback through the backend's "
+            "digest-only drain",
+        )
 
     def _check_sync(self, node: ast.Call) -> None:
         static = self._in_jit()

@@ -241,6 +241,64 @@ def run() -> "list[Finding]":
         except Exception as e:
             c.fail(e)
 
+    covers("codec_step", "encode_and_hash_words_digest")
+    c = ctx(
+        codec_step.encode_and_hash_words_digest,
+        "minio_tpu/ops/codec_step.py",
+    )
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        c.config = cfg_str(k, m, L)
+        try:
+            # identical contract to encode_and_hash_words: the digest
+            # variant only changes buffer lifetime (donated input,
+            # device-resident parity), never shapes or dtypes
+            parity, digests = (
+                codec_step.encode_and_hash_words_digest.eval_shape(
+                    S((_BATCH, k, w), u32), m, L
+                )
+            )
+            c.shape(parity, (_BATCH, m, w), "device-resident parity")
+            c.dtype(parity, "uint32", "device-resident parity")
+            c.shape(digests, (_BATCH, n, 8), "digests")
+            c.dtype(digests, "uint32", "digests")
+        except Exception as e:
+            c.fail(e)
+
+    # parity transport compression: group granularity must divide the
+    # words-per-shard of every grid config (smallest is 64B -> 16 words)
+    _GROUP = 8
+
+    covers("codec_step", "group_flags")
+    c = ctx(codec_step.group_flags, "minio_tpu/ops/codec_step.py")
+    for k, m, L in CONFIG_GRID:
+        w, g = L // 4, L // 4 // _GROUP
+        c.config = cfg_str(k, m, L)
+        try:
+            flags = codec_step.group_flags.eval_shape(
+                S((_BATCH, m, w), u32), _GROUP
+            )
+            c.shape(flags, (_BATCH, m, g), "group flags")
+            c.dtype(flags, "bool", "group flags")
+        except Exception as e:
+            c.fail(e)
+
+    covers("codec_step", "pack_nonzero_groups")
+    c = ctx(codec_step.pack_nonzero_groups, "minio_tpu/ops/codec_step.py")
+    for k, m, L in CONFIG_GRID:
+        w, g = L // 4, L // 4 // _GROUP
+        c.config = cfg_str(k, m, L)
+        try:
+            flags, packed = codec_step.pack_nonzero_groups.eval_shape(
+                S((_BATCH, m, w), u32), _GROUP
+            )
+            c.shape(flags, (_BATCH, m, g), "pack flags")
+            c.dtype(flags, "bool", "pack flags")
+            c.shape(packed, (_BATCH, m, w), "packed words")
+            c.dtype(packed, "uint32", "packed words")
+        except Exception as e:
+            c.fail(e)
+
     covers("codec_step", "verify_hashes_words")
     c = ctx(codec_step.verify_hashes_words, "minio_tpu/ops/codec_step.py")
     for k, m, L in CONFIG_GRID:

@@ -34,7 +34,7 @@ from .telemetry import KERNEL_STATS
 class _Job:
     __slots__ = (
         "op", "key", "arrays", "result", "error", "done", "created",
-        "client",
+        "client", "ended",
     )
 
     def __init__(self, op: str, key: tuple, arrays: tuple):
@@ -46,6 +46,35 @@ class _Job:
         self.done = threading.Event()
         self.created = time.monotonic()
         self.client = threading.get_ident()
+        # set by the first encode_end: a second end of the same handle
+        # (error-path cleanup racing the normal consume) must not
+        # decrement _active again — that corrupts the distinct-client
+        # flush signal for every later batch
+        self.ended = False
+
+
+class _SlicedParityRef:
+    """View of a coalesced batch's parity ref: drain pulls the PARENT
+    (one shared D2H for the whole merged flush) and hands back this
+    job's rows.  release is a no-op — sibling jobs may still need the
+    parent, which stays governed by the write-back cache either way."""
+
+    __slots__ = ("_parent", "_lo", "_hi")
+
+    def __init__(self, parent, lo: int, hi: int):
+        self._parent = parent
+        self._lo = lo
+        self._hi = hi
+
+    @property
+    def nbytes(self) -> int:
+        return 0  # the parent ref carries the cache accounting
+
+    def drain(self):
+        return self._parent.drain()[self._lo : self._hi]
+
+    def release(self) -> None:
+        return None
 
 
 class BatchingBackend(CodecBackend):
@@ -126,17 +155,55 @@ class BatchingBackend(CodecBackend):
 
     def encode_end(self, handle):
         job = handle
-        try:
-            job.done.wait()
-            if job.error is not None:
-                raise job.error
-            return job.result
-        finally:
-            with self._cv:
-                # pair with the SUBMITTING thread's entry: a pipelined
-                # caller may end a handle from a different thread
+        job.done.wait()
+        with self._cv:
+            # pair with the SUBMITTING thread's entry exactly once: a
+            # pipelined caller may end a handle from a different
+            # thread, and error-path cleanup may end it a second time
+            if not job.ended:
+                job.ended = True
                 self._exit(job.client)
                 self._cv.notify_all()
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    def encode_digest_begin(self, data, parity_shards):
+        """Digest-only twin of encode_begin: coalesces across requests
+        like encode, and admission BACKS OFF while the inner backend's
+        parity cache is over budget — the flush policy's cache-pressure
+        term, bounding device-resident parity under concurrency."""
+        self._cache_backoff()
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        B, k, L = data.shape
+        job = _Job("encode_digest", (k, L, parity_shards), (data,))
+        with self._cv:
+            self._enter(job.client)
+            self._jobs.append(job)
+            self._cv.notify_all()
+        return job
+
+    def encode_digest_end(self, handle):
+        # same handle protocol as encode_end (idempotent, _exit once);
+        # the result is (digests, parity_ref) instead of (parity, digests)
+        return self.encode_end(handle)
+
+    def parity_cache_pressure(self) -> float:
+        return self.inner.parity_cache_pressure()
+
+    def _cache_backoff(self, bound_s: float = 0.25) -> None:
+        """Stall new digest-encode admission briefly while the parity
+        cache is at/over budget, so lazy drains catch up instead of
+        every insert forcing a synchronous write-back eviction.  Time-
+        bounded: a wedged drain band degrades to eviction, not a hang."""
+        if self.inner.parity_cache_pressure() < 1.0:
+            return
+        deadline = time.monotonic() + bound_s
+        while (
+            self.inner.parity_cache_pressure() >= 1.0
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
 
     def digest(self, shards):
         shards = np.ascontiguousarray(shards, dtype=np.uint8)
@@ -277,6 +344,11 @@ class BatchingBackend(CodecBackend):
             if op == "encode":
                 parity, digests = out
                 j.result = (parity[lo:hi], digests[lo:hi])
+            elif op == "encode_digest":
+                digests, pref = out
+                j.result = (
+                    digests[lo:hi], _SlicedParityRef(pref, lo, hi)
+                )
             else:
                 j.result = out[lo:hi]
             j.done.set()
@@ -284,6 +356,10 @@ class BatchingBackend(CodecBackend):
     def _call(self, op: str, key: tuple, arr):
         if op == "encode":
             return self.inner.encode(arr, key[2])
+        if op == "encode_digest":
+            return self.inner.encode_digest_end(
+                self.inner.encode_digest_begin(arr, key[2])
+            )
         if op == "digest":
             return self.inner.digest(arr)
         if op == "reconstruct":

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import threading
 import time
 
@@ -63,6 +64,58 @@ def _io_key(obj):
     with a stable endpoint ``io_key``; untagged test doubles hash by
     identity (still one ordered queue per instance)."""
     return getattr(obj, "io_key", None) or ("anon", id(obj))
+
+
+def _parity_plane_on() -> bool:
+    """MINIO_TPU_PARITY_PLANE = on|off (default on): route PUT encodes
+    through the digest-only seam so parity stays device-resident until
+    the writers pull it (codec/backend.py).  "off" restores the legacy
+    eager encode_end readback."""
+    return os.environ.get("MINIO_TPU_PARITY_PLANE", "on") != "off"
+
+
+class _Begun:
+    """One begun encode group: the SINGLE consume point for its handle.
+
+    The success path calls ``end``/``end_digest`` exactly once; the
+    error path calls ``cleanup``, which is a no-op for already-consumed
+    records and otherwise ends the handle (releasing an undrained
+    parity ref without paying the D2H).  The consumed flag replaces the
+    old ``started[i] = None`` sentinel bookkeeping, so cleanup can
+    never double-consume or leak a device handle no matter which
+    iteration of the flush loop failed.
+    """
+
+    __slots__ = ("handle", "batch", "digest_mode", "consumed")
+
+    def __init__(self, handle, batch, digest_mode: bool):
+        self.handle = handle
+        self.batch = batch
+        self.digest_mode = digest_mode
+        self.consumed = False
+
+    def end(self, be):
+        self.consumed = True
+        return be.encode_end(self.handle)
+
+    def end_digest(self, be):
+        self.consumed = True
+        return be.encode_digest_end(self.handle)
+
+    def cleanup(self, be) -> None:
+        if self.consumed:
+            return
+        self.consumed = True
+        try:
+            if self.digest_mode:
+                _digests, ref = be.encode_digest_end(self.handle)
+                ref.release()
+            else:
+                be.encode_end(self.handle)
+        except Exception as exc:
+            _log.debug(
+                "encode handle cleanup failed", extra=kv(err=str(exc))
+            )
 
 
 def _fanout_reads(fn, slots: list, readers, nbytes: int) -> list:
@@ -161,6 +214,7 @@ class Erasure:
         write_quorum: int,
         batch_blocks: int = DEFAULT_BATCH_BLOCKS,
         backend: "backend_mod.CodecBackend | None" = None,
+        parity_band: "iopool.ParityBand | None" = None,
     ) -> int:
         """Stream from ``reader`` (has .read(n)) into framed shard writers.
 
@@ -168,11 +222,22 @@ class Erasure:
         consumed.  Raises QuorumError when healthy writers drop below
         write_quorum (the parallelWriter quorum reduction,
         erasure-encode.go:39-70).
+
+        With ``parity_band`` set (quorum-early commit), encode returns
+        once every DATA shard write settled and quorum holds — parity
+        writes keep draining in the background, adopted by the band:
+        a parity failure past this return is heal-flagged through the
+        band, never silent.  Requires the digest-only parity plane
+        (MINIO_TPU_PARITY_PLANE=on).
         """
         be = backend or backend_mod.get_backend()
         k, m = self.data_blocks, self.parity_blocks
+        digest_mode = _parity_plane_on() and m > 0
+        if parity_band is not None and not digest_mode:
+            parity_band = None  # legacy eager path settles in-line
         total = 0
         eof = False
+        band_adopted = False
         # quorum-aware shard fan-out: one ordered pool queue per disk,
         # flush() returns at write_quorum acks, stragglers drain in the
         # background (parallelWriter, erasure-encode.go:39-70)
@@ -180,7 +245,8 @@ class Erasure:
             iopool.get_pool(), quorum_exc=QuorumError
         )
         stages = {
-            "assemble": 0.0, "codec": 0.0, "codec_fused": 0.0, "disk": 0.0,
+            "assemble": 0.0, "codec": 0.0, "codec_fused": 0.0,
+            "codec_drain": 0.0, "disk": 0.0,
         }
         # double-buffered pipeline (erasure-encode.go:73-109 overlap,
         # SURVEY stage 8): batch k's H2D + device pass is in flight
@@ -201,7 +267,9 @@ class Erasure:
                     total += len(buf)
                 if not blocks:
                     break
-                started = self._encode_begin_batch(be, blocks, stages)
+                started = self._encode_begin_batch(
+                    be, blocks, stages, digest_mode
+                )
                 blocks = None  # scattered into the batch arrays above
                 if pending is not None:
                     try:
@@ -220,9 +288,17 @@ class Erasure:
                 )
             # early-acked batches may still have stragglers in flight:
             # settle them and re-check the quorum over the final disk
-            # liveness picture before declaring the object durable
+            # liveness picture before declaring the object durable.
+            # quorum-early mode settles only the DATA slots here — the
+            # parity stragglers are adopted by the band, and the
+            # liveness picture for them is optimistic until settle
             t0 = time.monotonic()
-            for s in flusher.drain():
+            dead = (
+                flusher.drain_slots(range(k))
+                if parity_band is not None
+                else flusher.drain()
+            )
+            for s in dead:
                 if s < len(writers):
                     writers[s] = None
             stages["disk"] += time.monotonic() - t0
@@ -232,6 +308,9 @@ class Erasure:
                     raise QuorumError(
                         f"write quorum lost: {alive} < {write_quorum}"
                     )
+            if parity_band is not None:
+                parity_band.adopt(flusher)
+                band_adopted = True
             KERNEL_STATS.record_stream("encode", total)
             KERNEL_STATS.record_stages("put", stages)
             return total
@@ -239,20 +318,20 @@ class Erasure:
             # an error mid-flush must not abandon begun handles: a
             # batching backend counts them active until ended, so a
             # leak would degrade every later codec call
-            for handle, _batch in pending or []:
-                try:
-                    be.encode_end(handle)
-                except Exception as exc:
-                    _log.debug("encode_end cleanup after failed flush", extra=kv(err=str(exc)))
+            for rec in pending or []:
+                rec.cleanup(be)
             # nor may background shard writes race the caller closing
-            # its writers: settle the pool before handing back
-            for s in flusher.drain():
-                if s < len(writers):
-                    writers[s] = None
+            # its writers: settle the pool before handing back — unless
+            # the band adopted the stragglers, in which case IT owns
+            # the settle (that deferral is the quorum-early ack)
+            if not band_adopted:
+                for s in flusher.drain():
+                    if s < len(writers):
+                        writers[s] = None
 
-    def _encode_begin_batch(self, be, blocks, stages):
+    def _encode_begin_batch(self, be, blocks, stages, digest_mode=False):
         """Kick off the device passes for one batch of blocks; returns
-        [(handle, batch_array), ...] per uniform-shard-size group."""
+        a list of _Begun records, one per uniform-shard-size group."""
         k = self.data_blocks
         m = self.parity_blocks
         # uniform batch: all blocks but possibly the last share shard size
@@ -282,7 +361,12 @@ class Erasure:
                     batch[bi, rows, :rem] = a[rows * ss :]
             stages["assemble"] += time.monotonic() - t0
             t0 = time.monotonic()
-            started.append((be.encode_begin(batch, m), batch))
+            handle = (
+                be.encode_digest_begin(batch, m)
+                if digest_mode
+                else be.encode_begin(batch, m)
+            )
+            started.append(_Begun(handle, batch, digest_mode))
             stages[_codec_stage(be)] += time.monotonic() - t0
         return started
 
@@ -298,14 +382,11 @@ class Erasure:
             )
         except BaseException:
             # end the groups the failed iteration never reached
-            # (batching backends count begun handles as active)
-            for item in started:
-                if item is None:
-                    continue  # already consumed by encode_end
-                try:
-                    be.encode_end(item[0])
-                except Exception as exc:
-                    _log.debug("encode_end cleanup on error path", extra=kv(err=str(exc)))
+            # (batching backends count begun handles as active);
+            # _Begun.cleanup skips consumed records, so this can never
+            # double-end a handle the loop already materialized
+            for rec in started:
+                rec.cleanup(be)
             raise
 
     @staticmethod
@@ -331,6 +412,31 @@ class Erasure:
             w.write(run.reshape(-1).data)
         return _job
 
+    @staticmethod
+    def _run_parity_writer(w, dig_s, pref, col, ds, stages):
+        """Parity twin of _run_writer for the digest-only path: the
+        closure pins the ParityRef, not host bytes.  The first parity
+        job to run pays the (memoized, possibly device-compressed) lazy
+        drain on its own iopool worker — behind the data-quorum ack —
+        and the sibling parity disks reuse the materialized plane."""
+        def _job():
+            t0 = time.monotonic()
+            par = pref.drain()
+            dt = time.monotonic() - t0
+            with _STAGE_LK:
+                stages["codec_drain"] += dt
+            t0 = time.monotonic()
+            shard = par[:, col, :]
+            B = shard.shape[0]
+            run = np.empty((B, ds + shard.shape[1]), dtype=np.uint8)
+            run[:, :ds] = dig_s
+            run[:, ds:] = shard
+            dt = time.monotonic() - t0
+            with _STAGE_LK:
+                stages["assemble"] += dt
+            w.write(run.reshape(-1).data)
+        return _job
+
     def _flush_groups(
         self, be, started, writers, write_quorum, k, n,
         flusher, stages,
@@ -338,12 +444,22 @@ class Erasure:
         """Assemble each disk's contiguous byte run for the whole batch
         with one numpy interleave (digest frames + payload rows) and
         fan the n runs out through the iopool — ONE buffer per disk per
-        batch, the write twin of the one-ranged-read-per-shard GET."""
+        batch, the write twin of the one-ranged-read-per-shard GET.
+
+        Digest-mode records materialize ONLY the digests here (all the
+        metadata/ack path needs); their parity crosses the bus lazily
+        inside the parity writers' jobs via the ParityRef."""
         jobs = []
-        for i, (handle, batch) in enumerate(started):
-            started[i] = None  # consumed: error path must not re-end
+        for rec in started:
+            batch = rec.batch
             t0 = time.monotonic()
-            parity, digests = be.encode_end(handle)
+            if rec.digest_mode:
+                digests, pref = rec.end_digest(be)
+                par = None
+            else:
+                parity, digests = rec.end(be)
+                par = np.asarray(parity, dtype=np.uint8)
+                pref = None
             stages[_codec_stage(be)] += time.monotonic() - t0
             t0 = time.monotonic()
             B, shard_len = batch.shape[0], batch.shape[2]
@@ -355,25 +471,25 @@ class Erasure:
                 .view(np.uint8)
                 .reshape(B, n, ds)
             )
-            par = np.asarray(parity, dtype=np.uint8)
             stages["assemble"] += time.monotonic() - t0
             for s in range(n):
                 w = writers[s] if s < len(writers) else None
                 if w is None:
                     continue
-                jobs.append((
-                    s,
-                    _io_key(w),
-                    self._run_writer(
+                if s >= k and pref is not None:
+                    fn = self._run_parity_writer(
+                        w, dig[:, s, :], pref, s - k, ds, stages
+                    )
+                else:
+                    fn = self._run_writer(
                         w,
                         dig[:, s, :],
                         batch if s < k else par,
                         s if s < k else s - k,
                         ds,
                         stages,
-                    ),
-                    B * (ds + shard_len),
-                ))
+                    )
+                jobs.append((s, _io_key(w), fn, B * (ds + shard_len)))
         alive = {s for s, _key, _fn, _nb in jobs}
         if len(alive) < write_quorum:
             raise QuorumError(

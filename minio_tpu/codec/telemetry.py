@@ -58,6 +58,11 @@ class KernelStats:
         self._iopool_slowest_s = 0.0
         # hedged shard reads: kind in {launched, won, wasted}
         self._hedge: "dict[str, int]" = {}
+        # device->host readback by plane: plane -> [transfers, bytes];
+        # plane in {"data", "parity"} (digests ride the data plane).
+        # The parity-plane PUT restructure exists to drive the parity
+        # row of this table to the post-ack drain band only
+        self._d2h: "dict[str, list]" = {}
 
     # -- recording --------------------------------------------------------
 
@@ -88,6 +93,13 @@ class KernelStats:
     def record_heal_required(self) -> None:
         with self._mu:
             self._heal_required += 1
+
+    def record_d2h(self, plane: str, nbytes: int) -> None:
+        """One device->host codec transfer (plane = data|parity)."""
+        with self._mu:
+            row = self._d2h.setdefault(plane, [0, 0])
+            row[0] += 1
+            row[1] += nbytes
 
     def record_stages(self, op: str, stages: "dict[str, float]") -> None:
         """One stream's stage breakdown (assemble / codec / disk)."""
@@ -157,6 +169,11 @@ class KernelStats:
                     )
                 ],
                 "heal_required": self._heal_required,
+                "d2h": [
+                    {"plane": plane, "transfers": n, "bytes": nbytes}
+                    for plane, (n, nbytes) in sorted(self._d2h.items())
+                ],
+                "parity_cache": _parity_cache_stats(),
                 "hedge": {
                     kind: self._hedge.get(kind, 0)
                     for kind in ("launched", "won", "wasted")
@@ -202,6 +219,16 @@ class KernelStats:
             self._iopool_depth_hwm = 0
             self._iopool_slowest_s = 0.0
             self._hedge.clear()
+            self._d2h.clear()
+
+
+def _parity_cache_stats() -> dict:
+    """Live occupancy of the device parity-plane cache (backend.py) —
+    read at snapshot time, not accumulated here, because the cache is
+    its own source of truth for current occupancy."""
+    from . import backend as backend_mod
+
+    return backend_mod.parity_cache_stats()
 
 
 # Process-wide singleton: one codec seam per process (backend.py caches
@@ -270,6 +297,36 @@ class InstrumentedBackend(CodecBackend):
                 nbytes,
                 dispatch_s + (time.monotonic() - t0),
             )
+
+    def encode_digest_begin(self, data, parity_shards):
+        # digest-only twin of the encode pair: same one-call recording
+        # at end, under the op name "encode_digest" so the readback
+        # restructure shows up as its own series next to "encode"
+        t0 = time.monotonic()
+        handle = self.inner.encode_digest_begin(data, parity_shards)
+        return ("ktel", handle, time.monotonic() - t0, data.nbytes)
+
+    def encode_digest_end(self, handle):
+        if not (
+            isinstance(handle, tuple)
+            and len(handle) == 4
+            and handle[0] == "ktel"
+        ):
+            return self.inner.encode_digest_end(handle)
+        _tag, inner_handle, dispatch_s, nbytes = handle
+        t0 = time.monotonic()
+        try:
+            return self.inner.encode_digest_end(inner_handle)
+        finally:
+            self.stats.record_op(
+                "encode_digest",
+                self.name,
+                nbytes,
+                dispatch_s + (time.monotonic() - t0),
+            )
+
+    def parity_cache_pressure(self) -> float:
+        return self.inner.parity_cache_pressure()
 
     def digest(self, shards):
         return self._timed(

@@ -9,6 +9,7 @@ the codec work itself is the batched device pass in codec/erasure.py.
 
 from __future__ import annotations
 
+import os
 import time
 import uuid
 
@@ -50,6 +51,18 @@ from .metadata import (
 )
 
 SYS_VOL = ".sys"
+
+
+def _parity_ack_mode() -> str:
+    """MINIO_TPU_PARITY_ACK = settle|early (default settle).
+
+    settle: PUT returns only after every shard (parity included) is
+    written, closed and renamed — the fully-deterministic path.
+    early: PUT acks at DATA-shard write quorum; parity writes, closes
+    and renames drain in a background ParityBand whose failures are
+    heal-flagged through the MRF hook (quorum-early parity drain)."""
+    v = os.environ.get("MINIO_TPU_PARITY_ACK", "settle").lower()
+    return v if v in ("settle", "early") else "settle"
 
 
 from .erasure_multipart import MultipartMixin
@@ -307,8 +320,17 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             except Exception:  # noqa: BLE001
                 writers.append(None)
 
+        # quorum-early commit: the band adopts parity stragglers at
+        # encode return, then carries parity close/rename past the ack
+        band = (
+            iopool.ParityBand()
+            if _parity_ack_mode() == "early" and m > 0
+            else None
+        )
         try:
-            total = er.encode(src, writers, self.write_quorum)
+            total = er.encode(
+                src, writers, self.write_quorum, parity_band=band
+            )
         except QuorumError as e:
             # close writers FIRST: streaming remote writers own sender
             # threads that must terminate before staging is reaped
@@ -320,15 +342,24 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         _log.debug("shard writer close failed", extra=kv(err=str(exc)))
             self._cleanup_tmp(disks, tmp_ids)
             raise WriteQuorumError(str(e)) from e
-        # close (flush + fsync) every shard file concurrently, one job
-        # per disk queue: the commit pays the slowest disk's fsync, not
-        # the sum over n disks
+        if band is not None and not band.adopted:
+            band = None  # encode fell back to the legacy settle path
+        # close (flush + fsync) shard files concurrently, one job per
+        # disk queue: the commit pays the slowest disk's fsync, not the
+        # sum over n disks.  Early mode closes only the DATA shards
+        # here; parity closes ride the band, ordered after that disk's
+        # writes by its queue
+        close_inline = [
+            w
+            for s, w in enumerate(writers)
+            if w is not None and (band is None or s < k)
+        ]
+        if band is not None:
+            for s, w in enumerate(writers):
+                if s >= k and w is not None:
+                    band.submit(s, iopool.stream_io_key(w), w.close)
         for err in iopool.fanout(
-            [
-                (iopool.stream_io_key(w), w.close)
-                for w in writers
-                if w is not None
-            ]
+            [(iopool.stream_io_key(w), w.close) for w in close_inline]
         ):
             if err is not None and not isinstance(err, OSError):
                 raise err
@@ -354,7 +385,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
 
         # rename_data commits the version journal with its own fsync
         # per disk: fan the commits out on the disk queues and gather
-        # per-slot errors in order
+        # per-slot errors in order.  Early mode renames only the data
+        # shards before acking; parity renames ride the band (same
+        # per-disk key as that disk's close, so ordering holds) and
+        # their slot errors stay optimistically None until settle
         rename_ops = []
         errs: list = [None] * len(disks)
         for i, d in enumerate(disks):
@@ -378,15 +412,13 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     distribution=distribution,
                 ),
             )
-            rename_ops.append(
-                (
-                    i,
-                    iopool.disk_io_key(d) or f"disk-{i}",
-                    lambda d=d, fi=fi, tmp=tmp_ids[i]: d.rename_data(
-                        SYS_VOL, f"tmp/{tmp}", fi, bucket, object_name
-                    ),
-                )
+            fn = lambda d=d, fi=fi, tmp=tmp_ids[i]: d.rename_data(  # noqa: E731
+                SYS_VOL, f"tmp/{tmp}", fi, bucket, object_name
             )
+            if band is not None and i >= k:
+                band.submit(i, iopool.stream_io_key(writers[i]), fn)
+                continue
+            rename_ops.append((i, iopool.disk_io_key(d) or f"disk-{i}", fn))
         for (i, _k, _f), err in zip(
             rename_ops,
             iopool.fanout([(key, fn) for _i, key, fn in rename_ops]),
@@ -406,6 +438,22 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 self.heal_hook(bucket, object_name)
             except Exception as exc:
                 _log.debug("partial-write heal hook failed", extra=kv(err=str(exc)))
+        if band is not None:
+            # settle the parity plane in the background; anything that
+            # fails past this ack is heal-flagged through the MRF hook
+            hook = self.heal_hook
+
+            def _on_settled(b, _bucket=bucket, _obj=object_name):
+                if b.heal_required and hook is not None:
+                    try:
+                        hook(_bucket, _obj)
+                    except Exception as exc:
+                        _log.debug(
+                            "parity settle heal hook failed",
+                            extra=kv(err=str(exc)),
+                        )
+
+            band.finish(on_done=_on_settled)
         # overwrite cleanup: drop the replaced data dir (best effort)
         if old_data_dir and old_data_dir != data_dir:
             for d in disks:

@@ -20,12 +20,21 @@ These are the kernels the object layer batches concurrent requests into
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import gf, hash as phash, rs, rs_pallas
+
+# encode_and_hash_words_digest donates its input buffer so the device
+# reuses the H2D staging allocation for parity; on host-only platforms
+# (the CPU test backend) XLA cannot always honor the donation and says
+# so per call — that is expected there, not a bug worth a warning storm.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 
 def host_bytes_to_words(a: np.ndarray) -> np.ndarray:
@@ -75,6 +84,75 @@ def encode_and_hash_words(
     )  # (n, B, w)
     digests = phash.phash256_words_batched(aw, shard_len)  # (n, B, 8)
     return parity.transpose(1, 0, 2), digests.transpose(1, 0, 2)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("parity_shards", "shard_len"),
+    donate_argnums=(0,),
+)
+def encode_and_hash_words_digest(
+    words: jax.Array, parity_shards: int, shard_len: int
+):
+    """Digest-only fused encode: the device-resident-parity variant.
+
+    Same math and same outputs as encode_and_hash_words, with two
+    contract differences the PUT pipeline builds on:
+
+    * ``words`` is DONATED — the H2D input buffer is dead after the
+      pass, so XLA may reuse it for parity instead of allocating, and
+      the caller must not touch its jax copy again.
+    * The caller materializes ONLY ``digests`` eagerly (32 bytes per
+      shard — all encode_end needs to frame bitrot metadata and ack);
+      ``parity`` stays a device array parked in the backend's parity
+      plane cache until the write path drains it D2H lazily.
+    """
+    return encode_and_hash_words(words, parity_shards, shard_len)
+
+
+@functools.partial(jax.jit, static_argnames=("group",))
+def group_flags(words: jax.Array, group: int):
+    """Per-group nonzero flags: (..., w) u32 -> (..., w//group) bool.
+
+    The cheap compressibility screen for the parity D2H transport:
+    reading the flags costs one bool per ``group`` words, and a mostly-
+    False mask means pack_nonzero_groups can shrink the bus transfer.
+    """
+    *lead, w = words.shape
+    if w % group:
+        raise ValueError("words per row must be a multiple of group")
+    g = w // group
+    return (words.reshape(*lead, g, group) != 0).any(axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("group",))
+def pack_nonzero_groups(words: jax.Array, group: int):
+    """Compact nonzero groups to the front of each row (device side).
+
+    (..., w) u32 -> (flags (..., g) bool, packed (..., w) u32) where
+    g = w // group.  Within each row the nonzero groups keep their
+    original relative order at the front and the zero groups follow, so
+    the host only pulls ``flags`` plus the first ``flags.sum()`` groups
+    over the bus and scatters them back by np.nonzero(flags) — the
+    fused on-device compression leg of the parity transport
+    (codec/compress.py unpack_nonzero_groups is the inverse).
+    """
+    *lead, w = words.shape
+    if w % group:
+        raise ValueError("words per row must be a multiple of group")
+    g = w // group
+    grouped = words.reshape(*lead, g, group)
+    flags = (grouped != 0).any(axis=-1)
+    # unique, strictly ordered sort keys (nonzero group j -> j, zero
+    # group j -> g + j): the permutation is deterministic without
+    # leaning on argsort stability guarantees
+    idx = jnp.arange(g, dtype=jnp.int32)
+    key = jnp.where(flags, 0, jnp.int32(g)) + idx
+    order = jnp.argsort(key, axis=-1)
+    packed = jnp.take_along_axis(
+        grouped, order[..., None], axis=-2
+    ).reshape(*lead, w)
+    return flags, packed
 
 
 @functools.partial(jax.jit, static_argnames=("shard_len",))
@@ -136,7 +214,10 @@ def encode_and_hash(data, parity_shards: int):
     parity, digests = encode_and_hash_words(
         words, parity_shards, shard_len
     )
-    parity_b = host_words_to_bytes(np.asarray(parity))
+    # eager by design: this byte-domain wrapper serves tests and small
+    # host-side callers that want concrete shards back; the hot path
+    # goes through the backend's digest-only seam instead
+    parity_b = host_words_to_bytes(np.asarray(parity))  # noqa: MTPU107
     shards = np.concatenate([data, parity_b], axis=1)
     return shards, np.asarray(digests)
 

@@ -341,6 +341,33 @@ class Metrics:
             "Decoded streams that reported shards needing heal",
             [({}, snap["heal_required"])],
         )
+        d2h = snap.get("d2h", [])
+        emit(
+            "miniotpu_codec_d2h_bytes_total", "counter",
+            "Device->host codec readback bytes by plane (data|parity)",
+            [({"plane": r["plane"]}, r["bytes"]) for r in d2h],
+        )
+        emit(
+            "miniotpu_codec_d2h_transfers_total", "counter",
+            "Device->host codec readback transfers by plane",
+            [({"plane": r["plane"]}, r["transfers"]) for r in d2h],
+        )
+        pc = snap.get("parity_cache", {})
+        emit(
+            "miniotpu_codec_parity_cache_bytes", "gauge",
+            "Device-resident parity plane bytes currently cached",
+            [({}, pc.get("occupancy_bytes", 0))],
+        )
+        emit(
+            "miniotpu_codec_parity_cache_entries", "gauge",
+            "Device-resident parity planes currently cached",
+            [({}, pc.get("entries", 0))],
+        )
+        emit(
+            "miniotpu_codec_parity_cache_evictions_total", "counter",
+            "Parity planes drained early by write-back eviction",
+            [({}, pc.get("evictions", 0))],
+        )
         hedge = snap.get("hedge", {})
         emit(
             "miniotpu_hedge_launched_total", "counter",

@@ -1,0 +1,31 @@
+"""Fixture: eager readback of device parity outputs (MTPU107).
+
+Linted under the rel_path ``minio_tpu/ops/bad_mtpu107.py`` so the
+parity-readback scope applies.  Each offending line carries a
+``# VIOLATION: MTPU###`` marker; the test derives the expected
+(rule, line) set from these markers.
+"""
+
+import jax
+import numpy as np
+
+
+def encode_and_write(words, parity_shards):
+    parity, digests = fused_encode(words, parity_shards)
+    par = np.asarray(parity)  # VIOLATION: MTPU107
+    return par, digests
+
+
+def flush_shards(parity_w):
+    # device_get in a device module also trips the general sync rule
+    host = jax.device_get(parity_w)  # VIOLATION: MTPU107 # VIOLATION: MTPU101
+    return host
+
+
+def copy_plane(parity):
+    plane = np.array(parity)  # VIOLATION: MTPU107
+    return plane
+
+
+def fused_encode(words, parity_shards):
+    return words, words

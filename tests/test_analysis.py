@@ -134,6 +134,9 @@ KNOWN_ENTRY_POINTS = {
     ("rs_pallas", "_mxu_matmul_jit"),
     ("rs_pallas", "encode_hash_fused"),
     ("codec_step", "encode_and_hash_words"),
+    ("codec_step", "encode_and_hash_words_digest"),
+    ("codec_step", "group_flags"),
+    ("codec_step", "pack_nonzero_groups"),
     ("codec_step", "verify_hashes_words"),
     ("codec_step", "reconstruct_words_batch"),
     ("codec_step", "encode_throughput_probe"),
@@ -192,6 +195,56 @@ def test_bad_fixture_exact_findings(name):
 def test_good_fixture_clean(name):
     found = _lint_fixture(name)
     assert found == [], "\n".join(f.render() for f in found)
+
+
+# -- MTPU107: parity readback is scoped to ops/ + codec/backend.py ------
+#
+# The fixtures are linted under an ops/ rel_path (the scope is path-
+# keyed, and tests/data/ is outside it), so they get their own tests
+# instead of riding the BAD_FIXTURES/GOOD_FIXTURES param lists.
+
+
+def test_bad_mtpu107_exact_findings_under_parity_scope():
+    expected = _expected_markers("bad_mtpu107.py")
+    assert expected, "bad_mtpu107.py declares no VIOLATION markers"
+    got = {
+        (f.rule, f.line)
+        for f in _lint_fixture(
+            "bad_mtpu107.py", rel_path="minio_tpu/ops/bad_mtpu107.py"
+        )
+    }
+    assert got == expected
+
+
+def test_good_mtpu107_clean_under_parity_scope():
+    found = _lint_fixture(
+        "good_mtpu107.py", rel_path="minio_tpu/ops/good_mtpu107.py"
+    )
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_mtpu107_applies_to_codec_backend_file():
+    found = _lint_fixture(
+        "bad_mtpu107.py", rel_path="minio_tpu/codec/backend.py"
+    )
+    rules = {(f.rule, f.line) for f in found}
+    # the np.asarray/np.array sites fire under the backend scope too;
+    # line numbers match the ops-scope markers
+    assert {
+        (r, ln)
+        for r, ln in _expected_markers("bad_mtpu107.py")
+        if r == "MTPU107"
+    } <= rules
+
+
+def test_mtpu107_silent_outside_parity_scope():
+    """The same source linted under server/ raises no MTPU107."""
+    found = _lint_fixture(
+        "bad_mtpu107.py", rel_path="minio_tpu/server/bad_mtpu107.py"
+    )
+    assert not any(f.rule == "MTPU107" for f in found), "\n".join(
+        f.render() for f in found
+    )
 
 
 def test_noqa_suppresses_matching_rule():

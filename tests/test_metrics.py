@@ -342,14 +342,20 @@ def test_live_codec_families(server, client):
     backends = {lab["backend"] for _n, lab, _v in ops["samples"]}
     assert backends and backends <= {"tpu", "cpu"}, backends
     opnames = {lab["op"] for _n, lab, _v in ops["samples"]}
-    assert "encode" in opnames and "digest" in opnames, opnames
+    # digest-only parity-plane PUTs register as encode_digest
+    assert opnames & {"encode", "encode_digest"}, opnames
+    assert "digest" in opnames, opnames
     by_op = {
         (lab["op"], lab["backend"]): v
         for _n, lab, v in get_family(
             families, "miniotpu_codec_bytes_total"
         )["samples"]
     }
-    assert any(v > 0 for (op, _be), v in by_op.items() if op == "encode")
+    assert any(
+        v > 0
+        for (op, _be), v in by_op.items()
+        if op in ("encode", "encode_digest")
+    )
     secs = get_family(families, "miniotpu_codec_seconds_total")
     assert any(v > 0 for _n, _lab, v in secs["samples"])
     streams = get_family(families, "miniotpu_codec_streams_total")
@@ -384,7 +390,11 @@ def test_codec_roundtrip_records_nonzero(server, client):
         if any(s["kind"] == "decode" for s in snap["streams"]):
             break
         time.sleep(0.02)
-    enc = [o for o in snap["ops"] if o["op"] == "encode"]
+    # digest-only parity plane PUTs record encode_digest; legacy eager
+    # encodes record encode - the round-trip must land one of them
+    enc = [
+        o for o in snap["ops"] if o["op"] in ("encode", "encode_digest")
+    ]
     dig = [o for o in snap["ops"] if o["op"] == "digest"]
     assert enc and all(o["bytes"] > 0 and o["seconds"] > 0 for o in enc)
     assert dig and all(o["bytes"] > 0 and o["seconds"] > 0 for o in dig)
@@ -398,7 +408,11 @@ def test_admin_kernel_stats_route(server, client):
     assert r.status == 200, r.body
     doc = json.loads(r.body)
     assert {"ops", "batch", "streams", "heal_required"} <= set(doc)
-    assert any(o["op"] == "encode" for o in doc["ops"])
+    assert any(
+        o["op"] in ("encode", "encode_digest") for o in doc["ops"]
+    )
+    # the parity-plane counters ride the same snapshot
+    assert "d2h" in doc and "parity_cache" in doc
 
 
 def test_admin_healthinfo_includes_api_stats(server, client):
