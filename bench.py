@@ -762,6 +762,244 @@ def _kernel_stats_snapshot():
     return KERNEL_STATS.snapshot()
 
 
+def bench_concurrency_sweep(
+    obj_mib: int = 1,
+    levels=(1, 4, 8, 16, 32, 64),
+    ops_per_level: int = 96,
+) -> dict:
+    """Request-plane sweep (--concurrency): GET and PUT latency under
+    1..64 persistent keep-alive clients, async event-loop plane vs the
+    threaded oracle, through the full HTTP stack (SigV4 auth, erasure
+    object layer).  CPU codec backend so the axon relay's H2D latency
+    does not drown the request-plane signal under test.
+
+    Also runs a constrained shed probe (2 workers, 2-deep handler
+    queue, 16 clients) so the 503 SlowDown admission path shows up in
+    the numbers, not just the unit tests.
+    """
+    import concurrent.futures
+    import datetime
+    import hashlib
+    import http.client
+    import math
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu.codec import backend as backend_mod
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.server import auth
+    from minio_tpu.server.http import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    size = obj_mib << 20
+    payload = np.random.default_rng(13).integers(
+        0, 256, size, dtype=np.uint8
+    ).tobytes()
+    phash_put = hashlib.sha256(payload).hexdigest()
+    phash_empty = hashlib.sha256(b"").hexdigest()
+
+    class _Client:
+        """Persistent keep-alive connection issuing SigV4 requests."""
+
+        def __init__(self, endpoint):
+            host, port = endpoint.split("//")[1].rsplit(":", 1)
+            self.host, self.port = host, int(port)
+            self.conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=120
+            )
+
+        def request(self, method, path, body=b""):
+            amz = datetime.datetime.now(datetime.timezone.utc).strftime(
+                "%Y%m%dT%H%M%SZ"
+            )
+            phash = phash_put if body else phash_empty
+            headers = {
+                "host": f"{self.host}:{self.port}",
+                "x-amz-date": amz,
+                "x-amz-content-sha256": phash,
+            }
+            signed = sorted(headers)
+            sig = auth.sign_v4(
+                method, path, {}, headers, signed, phash,
+                "minioadmin", "minioadmin", amz, "us-east-1",
+            )
+            scope = f"{amz[:8]}/us-east-1/s3/aws4_request"
+            headers["authorization"] = (
+                f"{auth.SIGN_V4_ALGORITHM} "
+                f"Credential=minioadmin/{scope}, "
+                f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+            )
+            try:
+                self.conn.request(
+                    method, path, body=body or None, headers=headers
+                )
+                r = self.conn.getresponse()
+                r.read()
+                return r.status
+            except (http.client.HTTPException, OSError):
+                # server closed the connection (e.g. after a shed) -
+                # reconnect like a real SDK would
+                self.conn.close()
+                self.conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=120
+                )
+                raise
+
+        def close(self):
+            self.conn.close()
+
+    def _pct(lats, q):
+        return sorted(lats)[max(0, math.ceil(len(lats) * q) - 1)]
+
+    def _boot(mode, root, **env):
+        saved = {
+            k: os.environ.get(k) for k in ("MINIO_TPU_SERVER", *env)
+        }
+        os.environ["MINIO_TPU_SERVER"] = mode
+        for k, v in env.items():
+            os.environ[k] = str(v)
+        disks = [XLStorage(f"{root}/d{i}") for i in range(8)]
+        ol = ErasureObjects(disks, parity_blocks=4, block_size=BLOCK)
+        srv = S3Server(ol, address="127.0.0.1:0").start()
+        return srv, saved
+
+    def _restore(saved):
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def _fanout(endpoint, clients, op, n_ops, keys):
+        """n_ops requests spread over `clients` persistent
+        connections; returns (latencies, shed_503_count)."""
+        per = max(1, n_ops // clients)
+        sheds = [0]
+
+        def worker(cid):
+            c = _Client(endpoint)
+            lats = []
+            try:
+                for i in range(per):
+                    key = keys[(cid * per + i) % len(keys)]
+                    t0 = time.perf_counter()
+                    if op == "GET":
+                        st = c.request("GET", f"/bench/{key}")
+                    else:
+                        st = c.request(
+                            "PUT", f"/bench/w{cid}-{i}", payload
+                        )
+                    dt = time.perf_counter() - t0
+                    if st == 503:
+                        sheds[0] += 1  # GIL-atomic int bump
+                    else:
+                        lats.append(dt)
+            finally:
+                c.close()
+            return lats
+
+        lats = []
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            for f in [ex.submit(worker, i) for i in range(clients)]:
+                lats.extend(f.result())
+        return lats, sheds[0]
+
+    saved_backend = os.environ.get("MINIO_ERASURE_BACKEND")
+    os.environ["MINIO_ERASURE_BACKEND"] = "cpu"
+    backend_mod.reset_backend()
+    results = {"object_mib": obj_mib, "levels": [], "shed_probe": None}
+    try:
+        for mode in ("threaded", "async"):
+            root = tempfile.mkdtemp(prefix=f"minio-tpu-csweep-{mode}-")
+            srv, saved = _boot(mode, root)
+            try:
+                boot = _Client(srv.endpoint)
+                assert boot.request("PUT", "/bench") == 200
+                keys = [f"o{i}" for i in range(16)]
+                for k in keys:
+                    assert boot.request(
+                        "PUT", f"/bench/{k}", payload
+                    ) == 200
+                boot.close()
+                _fanout(srv.endpoint, 4, "GET", 16, keys)  # warm
+                for clients in levels:
+                    row = {"mode": mode, "clients": clients}
+                    for op in ("GET", "PUT"):
+                        s0 = srv.plane_stats.snapshot()["shed"]
+                        lats, shed = _fanout(
+                            srv.endpoint, clients, op,
+                            ops_per_level, keys,
+                        )
+                        s1 = srv.plane_stats.snapshot()["shed"]
+                        key = op.lower()
+                        row[f"{key}_ops"] = len(lats)
+                        row[f"{key}_p50_ms"] = round(
+                            _pct(lats, 0.5) * 1e3, 1
+                        )
+                        row[f"{key}_p99_ms"] = round(
+                            _pct(lats, 0.99) * 1e3, 1
+                        )
+                        row[f"{key}_shed_503"] = shed
+                        row[f"{key}_plane_shed"] = {
+                            r: s1[r] - s0[r] for r in s1 if s1[r] - s0[r]
+                        }
+                    results["levels"].append(row)
+            finally:
+                srv.shutdown(drain_s=5.0)
+                _restore(saved)
+                shutil.rmtree(root, ignore_errors=True)
+
+        # shed probe: constrain the async handler stage so admission
+        # actually refuses work, and report how many 503s land
+        root = tempfile.mkdtemp(prefix="minio-tpu-csweep-shed-")
+        srv, saved = _boot(
+            "async", root,
+            MINIO_TPU_SERVER_WORKERS=2, MINIO_TPU_SERVER_BACKLOG=2,
+        )
+        try:
+            boot = _Client(srv.endpoint)
+            assert boot.request("PUT", "/bench") == 200
+            keys = ["p0", "p1"]
+            for k in keys:
+                assert boot.request("PUT", f"/bench/{k}", payload) == 200
+            boot.close()
+            s0 = srv.plane_stats.snapshot()["shed"]
+            lats, shed = _fanout(srv.endpoint, 16, "GET", 64, keys)
+            s1 = srv.plane_stats.snapshot()["shed"]
+            results["shed_probe"] = {
+                "workers": 2, "backlog": 2, "clients": 16,
+                "completed": len(lats), "shed_503": shed,
+                "plane_shed": {
+                    r: s1[r] - s0[r] for r in s1 if s1[r] - s0[r]
+                },
+            }
+        finally:
+            srv.shutdown(drain_s=5.0)
+            _restore(saved)
+            shutil.rmtree(root, ignore_errors=True)
+    finally:
+        if saved_backend is None:
+            os.environ.pop("MINIO_ERASURE_BACKEND", None)
+        else:
+            os.environ["MINIO_ERASURE_BACKEND"] = saved_backend
+        backend_mod.reset_backend()
+
+    by = {
+        (r["mode"], r["clients"]): r for r in results["levels"]
+    }
+    ratios = {}
+    for op in ("get", "put"):
+        t = by.get(("threaded", 32))
+        a = by.get(("async", 32))
+        if t and a and a[f"{op}_p99_ms"]:
+            ratios[f"{op}_p99_ratio_32"] = round(
+                t[f"{op}_p99_ms"] / a[f"{op}_p99_ms"], 2
+            )
+    results["acceptance"] = ratios
+    return results
+
+
 def main() -> None:
     import argparse
     import os
@@ -796,7 +1034,17 @@ def main() -> None:
         "D2H byte accounting, legacy vs digest-only + quorum-early "
         "drain, on-disk shard bit-identity) and print its JSON",
     )
+    ap.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="run ONLY the request-plane concurrency sweep (1..64 "
+        "keep-alive clients, GET+PUT p50/p99 + shed counts, async "
+        "event-loop plane vs threaded oracle) and print its JSON",
+    )
     args = ap.parse_args()
+    if args.concurrency:
+        print(json.dumps(bench_concurrency_sweep(), indent=1))
+        return
     if args.codec_micro:
         print(json.dumps(bench_codec_micro(), indent=1))
         return

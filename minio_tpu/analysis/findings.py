@@ -50,6 +50,13 @@ RULES: "dict[str, str]" = {
         "minio_tpu/ops or codec/backend.py (re-introduces the D2H "
         "round-trip the digest-only PUT path removed)"
     ),
+    "MTPU108": (
+        "event-loop-blocking call inside an async def under "
+        "minio_tpu/server/: time.sleep, raw socket send/recv, or a "
+        "Future.result()/Event.wait() that is not awaited (one stalled "
+        "coroutine stalls every connection; route blocking work through "
+        "the worker-pool bridge)"
+    ),
     "MTPU201": "kernel contract: wrong output dtype from a jitted entry point",
     "MTPU202": "kernel contract: wrong output shape from a jitted entry point",
     "MTPU203": (

@@ -58,6 +58,18 @@ _METRIC_NAME_RE = re.compile(r"^miniotpu_[a-z0-9_]+$")
 _LABEL_KEY_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 _METRIC_TYPES = {"counter", "gauge", "histogram"}
 
+# MTPU108: event-loop-blocking calls inside ``async def`` bodies of the
+# server plane.  One stalled coroutine stalls every connection on the
+# loop; blocking work belongs on the worker-pool bridge (server/aio.py
+# _LoopReader/_LoopWriter run blocking calls in *sync* defs on worker
+# threads, which this rule deliberately does not see).  Awaited calls
+# are exempt — ``await ev.wait()`` on an asyncio primitive is the
+# non-blocking form — as are coroutine factories passed directly to an
+# awaited ``asyncio.*`` wrapper (``await asyncio.wait_for(ev.wait(),``).
+_LOOP_SCOPE_PREFIXES = ("minio_tpu/server/",)
+_LOOP_BLOCK_SLEEPS = {"time.sleep", "_time.sleep"}
+_LOOP_SOCKET_ATTRS = {"recv", "recv_into", "sendall", "sendto", "recvfrom"}
+
 
 def _dotted(node: ast.AST) -> "str | None":
     """'jax.device_get' for Attribute/Name chains, else None."""
@@ -151,9 +163,17 @@ class _Linter(ast.NodeVisitor):
             rel_path.startswith(_PARITY_SCOPE_PREFIXES)
             or rel_path in _PARITY_SCOPE_FILES
         )
+        self.loop_scope = rel_path.startswith(_LOOP_SCOPE_PREFIXES)
         self.findings: "list[Finding]" = []
         # stack of (func_name, jit_static_names or None)
         self._funcs: "list[tuple[str, set | None]]" = []
+        # parallel stack: is the enclosing def async? (MTPU108 keys on
+        # the INNERMOST def — a sync closure inside an async def runs
+        # on whatever thread calls it, not on the loop)
+        self._async_stack: "list[bool]" = []
+        # Call nodes that are awaited (directly, or as a coroutine
+        # argument to an awaited asyncio.* wrapper)
+        self._awaited: "set[int]" = set()
 
     # -- helpers ----------------------------------------------------------
 
@@ -193,11 +213,23 @@ class _Linter(ast.NodeVisitor):
             self._check_retrace(node, static)
             break
         self._funcs.append((node.name, static))
+        self._async_stack.append(isinstance(node, ast.AsyncFunctionDef))
         self.generic_visit(node)
+        self._async_stack.pop()
         self._funcs.pop()
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
+
+    def visit_Await(self, node: ast.Await) -> None:
+        v = node.value
+        if isinstance(v, ast.Call):
+            self._awaited.add(id(v))
+            if (_dotted(v.func) or "").startswith("asyncio."):
+                for a in list(v.args) + [kw.value for kw in v.keywords]:
+                    if isinstance(a, ast.Call):
+                        self._awaited.add(id(a))
+        self.generic_visit(node)
 
     def _check_retrace(self, node, static: "set[str]") -> None:
         args = node.args
@@ -220,7 +252,55 @@ class _Linter(ast.NodeVisitor):
         self._check_sync(node)
         self._check_parity_readback(node)
         self._check_metric_emit(node)
+        self._check_loop_block(node)
         self.generic_visit(node)
+
+    def _check_loop_block(self, node: ast.Call) -> None:
+        """MTPU108: blocking call on the event-loop thread."""
+        if not self.loop_scope:
+            return
+        if not self._async_stack or not self._async_stack[-1]:
+            return
+        if id(node) in self._awaited:
+            return
+        dotted = _dotted(node.func) or ""
+        if dotted in _LOOP_BLOCK_SLEEPS:
+            self._emit(
+                "MTPU108",
+                node,
+                f"{dotted}() blocks the event loop inside an async def; "
+                "use `await asyncio.sleep(...)` or move the work to the "
+                "worker-pool bridge",
+            )
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return
+        attr = node.func.attr
+        if attr in _LOOP_SOCKET_ATTRS:
+            self._emit(
+                "MTPU108",
+                node,
+                f".{attr}() is a raw blocking socket call inside an "
+                "async def; use the connection's StreamReader/"
+                "StreamWriter on the loop",
+            )
+        elif attr == "result":
+            self._emit(
+                "MTPU108",
+                node,
+                ".result() blocks the event loop waiting on a future "
+                "inside an async def; await it (or bridge through "
+                "loop.run_in_executor)",
+            )
+        elif attr == "wait" and not dotted.startswith("asyncio."):
+            self._emit(
+                "MTPU108",
+                node,
+                f"{dotted or '.' + attr}() without await blocks the "
+                "event loop inside an async def (a threading.Event-"
+                "style wait, or an asyncio coroutine that never runs); "
+                "await an asyncio primitive instead",
+            )
 
     def _check_parity_readback(self, node: ast.Call) -> None:
         """MTPU107: eager parity D2H outside the *_end/drain seams."""

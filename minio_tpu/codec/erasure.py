@@ -643,8 +643,12 @@ class Erasure:
                 - block_start
             )
             if hi > lo:
+                # memoryview slice: the decoded block goes to the sink
+                # (socket, decompressor) without the copy a bytes slice
+                # would make — the async plane's transport consumes the
+                # view before the batch is released
                 try:
-                    writer.write(datas[j][lo:hi])
+                    writer.write(memoryview(datas[j])[lo:hi])
                 except compress.RangeSatisfied:
                     return written, True
                 written += hi - lo

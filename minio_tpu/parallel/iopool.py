@@ -298,6 +298,11 @@ class IOPool:
             if q.thread is not None and q.thread.is_alive()
         )
 
+    def queued_jobs(self) -> int:
+        """Jobs waiting (not yet dequeued) across every band — the
+        server plane's codec-stage queue-depth gauge samples this."""
+        return sum(len(q.items) for q in self._queues)
+
 
 class ShardFlusher:
     """Quorum-aware batch completion over an IOPool.
@@ -581,6 +586,14 @@ def get_pool() -> IOPool:
                 _POOL = IOPool()
             p = _POOL
     return p
+
+
+def queued_depth() -> int:
+    """Codec-stage queue-depth gauge for the server plane — reads the
+    singleton without instantiating it (a scrape must not boot an I/O
+    plane)."""
+    p = _POOL
+    return p.queued_jobs() if p is not None else 0
 
 
 def reset_pool() -> None:

@@ -408,6 +408,14 @@ class AdminAPI:
             "platform": platform.platform(),
             "python": platform.python_version(),
             "cpus": _os.cpu_count(),
+            # request-plane mode + admission/backpressure counters
+            # (server/admission.py PlaneStats)
+            "server_plane": dict(
+                getattr(self.s3, "plane_stats").snapshot(),
+                mode=getattr(self.s3, "server_mode", "threaded"),
+            )
+            if getattr(self.s3, "plane_stats", None) is not None
+            else {},
         }
         try:
             page = _os.sysconf("SC_PAGE_SIZE")

@@ -1,0 +1,192 @@
+"""Server-plane admission control + telemetry (ROADMAP item 4).
+
+The async request plane sheds load *before* a request reaches the
+handler pool and the codec queues (the reference's maxClients +
+per-tenant throttles, cmd/handler-api.go): an overloaded stage answers
+503 SlowDown instead of queueing unboundedly.  Three shed reasons:
+
+``queue``
+    The bounded handler backlog is full (or the global admission slot
+    timed out in the threaded plane).
+``tenant``
+    The claimed access key already holds its per-tenant inflight cap
+    (``MINIO_TPU_TENANT_MAX_INFLIGHT``; 0 = unlimited).  The key is
+    parsed from the Authorization header *unverified* — it gates
+    fairness, never privilege: SigV4 verification still happens on the
+    handler path exactly as before.  Keys unknown to the IAM subsystem
+    share one bucket so garbage cannot mint unbounded counters.
+``quota``
+    A PUT whose declared Content-Length would overflow the bucket's
+    hard quota, judged against the crawler's usage snapshot only — no
+    snapshot means no early shed, preserving the synchronous
+    ``XMinioAdminBucketQuotaExceeded`` path inside the handler.
+
+``PlaneStats`` is the shared observability surface for both server
+modes: inflight gauge, per-stage queue depths, shed counters.  It is
+sampled by the Prometheus exposition (server/metrics.py) and by admin
+healthinfo.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+from ..utils.log import kv, logger
+
+_log = logger("admission")
+
+SHED_REASONS = ("queue", "quota", "tenant")
+
+# Authorization: AWS4-HMAC-SHA256 Credential=AK/date/region/..., ...
+_CRED_RE = re.compile(r"Credential=([^/,\s]+)/")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name) or default)
+    except ValueError:
+        return default
+
+
+class PlaneStats:
+    """Thread-safe server-plane counters shared by both server modes."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.inflight = 0
+        self.shed = {r: 0 for r in SHED_REASONS}
+        # stage -> zero-arg depth sampler; stages register lazily so
+        # the threaded plane simply exposes fewer gauges
+        self._depth_fns: "dict[str, object]" = {}
+
+    def enter(self) -> None:
+        with self._mu:
+            self.inflight += 1
+
+    def leave(self) -> None:
+        with self._mu:
+            self.inflight = max(0, self.inflight - 1)
+
+    def shed_inc(self, reason: str) -> None:
+        with self._mu:
+            self.shed[reason] = self.shed.get(reason, 0) + 1
+
+    def register_stage(self, stage: str, depth_fn) -> None:
+        with self._mu:
+            self._depth_fns[stage] = depth_fn
+
+    def snapshot(self) -> dict:
+        """Point-in-time view for metrics/healthinfo rendering."""
+        with self._mu:
+            shed = dict(self.shed)
+            inflight = self.inflight
+            fns = dict(self._depth_fns)
+        depths = {}
+        for stage, fn in fns.items():
+            try:
+                depths[stage] = int(fn())
+            except Exception:  # noqa: BLE001 - a gauge must never 500 a scrape
+                depths[stage] = 0
+        return {
+            "inflight": inflight,
+            "shed": shed,
+            "stage_depth": depths,
+        }
+
+
+class AdmissionController:
+    """Tenant- and quota-keyed early shed, shared by both planes."""
+
+    def __init__(self, server, stats: PlaneStats):
+        self._s3 = server
+        self.stats = stats
+        self._mu = threading.Lock()
+        self._tenant_inflight: "dict[str, int]" = {}
+
+    # -- knobs ------------------------------------------------------------
+
+    def _tenant_max(self) -> int:
+        return _env_int("MINIO_TPU_TENANT_MAX_INFLIGHT", 0)
+
+    # -- tenant stage -----------------------------------------------------
+
+    def tenant_of(self, headers) -> str:
+        """Fairness key: the *claimed* access key, collapsed to "anon"
+        when absent or unknown to IAM (unverified by design — see the
+        module docstring)."""
+        auth_hdr = headers.get("Authorization") or ""
+        m = _CRED_RE.search(auth_hdr)
+        if not m:
+            return "anon"
+        ak = m.group(1)
+        try:
+            self._s3.iam.lookup_secret(ak)
+        except Exception:  # noqa: BLE001 - unknown key, shared bucket
+            return "anon"
+        return ak
+
+    def try_enter_tenant(self, tenant: str) -> bool:
+        """Take a tenant slot; False -> shed 503 reason=tenant."""
+        limit = self._tenant_max()
+        with self._mu:
+            if limit > 0 and self._tenant_inflight.get(tenant, 0) >= limit:
+                return False
+            self._tenant_inflight[tenant] = (
+                self._tenant_inflight.get(tenant, 0) + 1
+            )
+            return True
+
+    def leave_tenant(self, tenant: str) -> None:
+        with self._mu:
+            n = self._tenant_inflight.get(tenant, 0) - 1
+            if n <= 0:
+                self._tenant_inflight.pop(tenant, None)
+            else:
+                self._tenant_inflight[tenant] = n
+
+    def tenant_inflight(self) -> "dict[str, int]":
+        with self._mu:
+            return dict(self._tenant_inflight)
+
+    # -- quota stage ------------------------------------------------------
+
+    def quota_rejects_put(self, command: str, path: str, headers) -> bool:
+        """True when a PUT's declared size cannot fit the bucket's hard
+        quota per the crawler snapshot (enforceBucketQuota's
+        dataUsageCache consult) — shed before any body byte is read.
+
+        Deliberately snapshot-only: without a crawler the handler's
+        synchronous quota check still runs and keeps its exact error
+        code, so this stage can only ever shed earlier, never differ.
+        """
+        if command != "PUT":
+            return False
+        bucket = path.lstrip("/").split("/", 1)[0]
+        if not bucket:
+            return False
+        try:
+            size = int(headers.get("Content-Length") or 0)
+        except ValueError:
+            return False
+        if size <= 0:
+            return False
+        crawler = getattr(self._s3, "crawler", None)
+        if crawler is None:
+            return False
+        from ..objectlayer import quota as quotamod
+
+        try:
+            cfg = quotamod.config_for(self._s3.bucket_meta, bucket)
+            if cfg is None or cfg.quota_type != "hard":
+                return False
+            bu = crawler.usage().buckets.get(bucket)
+            if bu is None:
+                return False
+            return bu.size + size > cfg.quota
+        except Exception as exc:  # noqa: BLE001 - never shed on a broken gauge
+            _log.debug(
+                "quota precheck failed open", extra=kv(err=str(exc))
+            )
+            return False

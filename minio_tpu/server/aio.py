@@ -1,0 +1,565 @@
+"""Asyncio request plane (ROADMAP item 4; MINIO_TPU_SERVER=async).
+
+The reference serves thousands of connections on goroutines behind its
+custom L7 listener (cmd/http/server.go); a thread-per-request stdlib
+server on a GIL cannot do that — at 32 clients every blocked thread
+competes for the interpreter and p99 collapses.  This plane keeps ONE
+event-loop thread owning every socket and a small bounded worker pool
+running the existing synchronous handlers, so concurrency costs a queue
+slot instead of a thread:
+
+    accept -> [parse: loop] -> [admission: loop] -> [handler: bounded
+    pool] -> [codec/disk: parallel/iopool.py] -> response via loop
+
+Stage boundaries are explicit queues with backpressure; when the
+handler backlog is full the request is shed with 503 SlowDown *before*
+any body byte is read (server/admission.py).  The handlers themselves
+are unchanged — ``_Handler.route()`` runs on a worker thread over two
+thin bridges:
+
+``_LoopReader``
+    Blocking file-like over the connection's ``asyncio.StreamReader``.
+    Each ``read(n)`` is one ``run_coroutine_threadsafe`` round-trip, so
+    a PUT body streams chunk-by-chunk from the loop straight into
+    ``HashReader`` -> ``encode_begin`` with bounded memory — the loop
+    never holds a full body and the worker never touches the socket.
+
+``_LoopWriter``
+    Blocking writes through ``transport.write`` + ``drain()``.  A
+    ``memoryview`` passes to the transport unjoined (zero-copy GET: the
+    decoded block slices the iopool assembles go to the socket without
+    intermediate ``b"".join``); blocking the worker until the loop has
+    consumed the buffer makes caller-side buffer reuse safe and gives
+    natural per-connection flow control.
+
+Long-lived streaming endpoints (admin trace/console, bucket event
+listen) would starve a bounded pool, so they run on dedicated threads.
+The threaded plane stays available as the bisection oracle
+(``MINIO_TPU_SERVER=threaded``, house style of MINIO_TPU_PARITY_PLANE).
+
+Blocking calls inside ``async def`` bodies here are a correctness bug
+(one stalled coroutine stalls every connection): MTPU108 in
+minio_tpu/analysis lints for them; the bridges above are sync-side by
+construction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import os
+import queue
+import socket
+import threading
+import urllib.parse
+import uuid
+from http import client as _hclient
+
+from . import s3errors
+from . import response as xmlr
+from ..utils.log import kv, logger
+
+_log = logger("aio")
+
+# header-block cap, matching the stdlib server's per-line ceiling
+_MAX_HEAD = 1 << 16
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name) or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name) or default)
+    except ValueError:
+        return default
+
+
+def _default_workers() -> int:
+    """A few blocking-I/O slots per core, capped.  More workers than
+    this just interleaves CPU-bound codec work (GIL thrash) and
+    inflates p99 without adding throughput."""
+    return min(16, max(4, 4 * (os.cpu_count() or 1)))
+
+
+class _LoopReader:
+    """Synchronous file-like over the loop's StreamReader, used by the
+    handler thread.  Every call blocks the *worker*, never the loop."""
+
+    def __init__(self, plane: "AsyncPlane", reader: asyncio.StreamReader):
+        self._plane = plane
+        self._reader = reader
+
+    def _call(self, coro):
+        try:
+            fut = asyncio.run_coroutine_threadsafe(coro, self._plane.loop)
+            return fut.result()
+        except asyncio.TimeoutError:
+            raise socket.timeout("body read timed out") from None
+        except (RuntimeError, ConnectionError, asyncio.CancelledError) as e:
+            raise OSError(f"connection lost: {e}") from None
+
+    def read(self, n: int = -1) -> bytes:
+        timeout = self._plane.body_timeout
+
+        async def _rd():
+            return await asyncio.wait_for(self._reader.read(n), timeout)
+
+        return self._call(_rd())
+
+    def readline(self, limit: int = -1) -> bytes:
+        """Bounded line read (internode chunked framing uses 1024)."""
+        timeout = self._plane.body_timeout
+        reader = self._reader
+
+        async def _rl():
+            out = bytearray()
+            while limit < 0 or len(out) < limit:
+                b = await asyncio.wait_for(reader.read(1), timeout)
+                if not b:
+                    break
+                out += b
+                if b == b"\n":
+                    break
+            return bytes(out)
+
+        return self._call(_rl())
+
+
+class _LoopWriter:
+    """Synchronous writes through the loop's transport.
+
+    ``write`` hands the buffer (bytes or memoryview — unjoined) to
+    ``transport.write`` on the loop and blocks the worker through
+    ``drain()``, so a slow client backpressures its own worker instead
+    of growing an unbounded transport buffer."""
+
+    def __init__(self, plane: "AsyncPlane", writer: asyncio.StreamWriter):
+        self._plane = plane
+        self._writer = writer
+
+    def write(self, data) -> int:
+        n = len(data)
+        if n == 0:
+            return 0
+        writer = self._writer
+
+        async def _wr():
+            writer.write(data)
+            await writer.drain()
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _wr(), self._plane.loop
+            ).result()
+        except (RuntimeError, ConnectionError, asyncio.CancelledError) as e:
+            raise OSError(f"connection lost: {e}") from None
+        return n
+
+    def flush(self) -> None:  # writes are already synchronous
+        pass
+
+
+class _WorkerPool:
+    """Bounded handler stage: a full backlog means shed, not queue."""
+
+    def __init__(self, workers: int, backlog: int):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, backlog))
+        self._threads = [
+            threading.Thread(
+                target=self._run, name=f"aio-worker-{i}", daemon=True
+            )
+            for i in range(max(1, workers))
+        ]
+        for t in self._threads:
+            t.start()
+        self._streams: "set[threading.Thread]" = set()
+        self._streams_mu = threading.Lock()
+        self._stream_seq = 0
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    def try_submit(self, fn) -> bool:
+        try:
+            self._q.put_nowait(fn)
+            return True
+        except queue.Full:
+            return False
+
+    def spawn_stream(self, fn) -> None:
+        """Long-lived streaming request: dedicated thread so it cannot
+        starve the bounded pool (trace/console/listen endpoints)."""
+        with self._streams_mu:
+            self._stream_seq += 1
+            name = f"aio-stream-{self._stream_seq}"
+        t = threading.Thread(
+            target=self._run_stream, args=(fn,), name=name, daemon=True
+        )
+        with self._streams_mu:
+            self._streams.add(t)
+        t.start()
+
+    def _run_stream(self, fn) -> None:
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001
+            _log.debug("stream handler failed", extra=kv(err=str(exc)))
+        finally:
+            with self._streams_mu:
+                self._streams.discard(threading.current_thread())
+
+    def _run(self) -> None:
+        while True:
+            fn = self._q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001
+                _log.debug("handler job failed", extra=kv(err=str(exc)))
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        for _ in self._threads:
+            try:
+                self._q.put(None, timeout=timeout)
+            except queue.Full:
+                break
+        for t in self._threads:
+            t.join(timeout)
+        with self._streams_mu:
+            streams = list(self._streams)
+        for t in streams:
+            t.join(timeout)
+
+
+class AsyncPlane:
+    """One event loop + bounded worker pool serving the S3 surface."""
+
+    def __init__(self, server):
+        self.s3 = server
+        self.stats = server.plane_stats
+        self.adm = server.admission
+        self.loop = asyncio.new_event_loop()
+        self.header_timeout = _env_float("MINIO_TPU_HEADER_TIMEOUT_S", 30.0)
+        self.body_timeout = _env_float("MINIO_TPU_BODY_TIMEOUT_S", 60.0)
+        self.idle_timeout = _env_float("MINIO_TPU_IDLE_TIMEOUT_S", 60.0)
+        self.pool = _WorkerPool(
+            _env_int("MINIO_TPU_SERVER_WORKERS", _default_workers()),
+            _env_int("MINIO_TPU_SERVER_BACKLOG", 64),
+        )
+        self._conns: "set[asyncio.StreamWriter]" = set()
+        self._tasks: "set[asyncio.Task]" = set()
+        self._srv = None
+        self._thread: "threading.Thread | None" = None
+        self._handler_cls = None
+        self._stopped = False
+        self.port = 0
+        self.stats.register_stage("parse", lambda: len(self._conns))
+        self.stats.register_stage("handler", self.pool.depth)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, handler_cls, host: str, port: int, ssl_ctx=None):
+        self._handler_cls = handler_cls
+        self._thread = threading.Thread(
+            target=self._run_loop, name="aio-loop", daemon=True
+        )
+        self._thread.start()
+
+        async def _boot():
+            return await asyncio.start_server(
+                self._serve_conn, host, port, ssl=ssl_ctx, limit=_MAX_HEAD
+            )
+
+        self._srv = asyncio.run_coroutine_threadsafe(
+            _boot(), self.loop
+        ).result(timeout=30)
+        self.port = self._srv.sockets[0].getsockname()[1]
+        return self
+
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        try:
+            self.loop.run_forever()
+        finally:
+            try:
+                self.loop.close()
+            except Exception as exc:  # noqa: BLE001
+                _log.debug("loop close failed", extra=kv(err=str(exc)))
+
+    def stop(self, drain_s: float = 10.0) -> None:
+        import time as _time
+
+        if self._stopped or self.loop.is_closed():
+            return
+        self._stopped = True
+        if self._srv is not None:
+            self.loop.call_soon_threadsafe(self._srv.close)
+        # drain in-flight requests (admitted -> released in route())
+        deadline = _time.monotonic() + drain_s
+        while (
+            self.stats.snapshot()["inflight"] > 0
+            and _time.monotonic() < deadline
+        ):
+            _time.sleep(0.05)
+        # cut remaining connections while the loop still runs: pending
+        # bridge reads/writes fail fast and unblock their workers
+        def _cut():
+            for w in list(self._conns):
+                try:
+                    w.close()
+                except Exception as exc:  # noqa: BLE001
+                    _log.debug(
+                        "transport close failed", extra=kv(err=str(exc))
+                    )
+
+        self.loop.call_soon_threadsafe(_cut)
+
+        async def _gather():
+            tasks = [t for t in self._tasks if not t.done()]
+            if tasks:
+                await asyncio.wait(tasks, timeout=drain_s + 5.0)
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _gather(), self.loop
+            ).result(timeout=drain_s + 10.0)
+        except Exception as exc:  # noqa: BLE001
+            _log.debug("connection drain incomplete", extra=kv(err=str(exc)))
+        self.pool.shutdown()
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- connection handling ----------------------------------------------
+
+    async def _serve_conn(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._tasks.add(task)
+        self._conns.add(writer)
+        try:
+            first = True
+            while not self.s3.draining:
+                head = await self._read_head(reader, writer, first)
+                if head is None:
+                    return
+                first = False
+                if not await self._handle_one(reader, writer, head):
+                    return
+        except (ConnectionError, asyncio.CancelledError, OSError):
+            pass
+        finally:
+            self._conns.discard(writer)
+            if task is not None:
+                self._tasks.discard(task)
+            try:
+                writer.close()
+            except Exception as exc:  # noqa: BLE001
+                _log.debug(
+                    "connection close failed", extra=kv(err=str(exc))
+                )
+
+    async def _read_head(self, reader, writer, first: bool):
+        """One request head (bytes through the blank line), or None on
+        EOF/timeout/oversize.  The timeout caps the WHOLE head — a
+        slow-loris trickling header bytes gets 408, not a held slot."""
+        timeout = self.header_timeout if first else self.idle_timeout
+        try:
+            return await asyncio.wait_for(
+                reader.readuntil(b"\r\n\r\n"), timeout
+            )
+        except asyncio.TimeoutError:
+            await self._reject(writer, 408, "RequestTimeout",
+                               "request header read timed out")
+            return None
+        except asyncio.LimitOverrunError:
+            await self._reject(writer, 431, "InvalidRequest",
+                               "request header block too large")
+            return None
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            return None  # client went away
+
+    async def _handle_one(self, reader, writer, head: bytes) -> bool:
+        """Parse + admit + dispatch one request; False ends the
+        connection (keep-alive otherwise)."""
+        try:
+            requestline, command, raw_path, version, headers = (
+                self._parse_head(head)
+            )
+        except ValueError as e:
+            await self._reject(writer, 400, "InvalidRequest", str(e))
+            return False
+        parsed = urllib.parse.urlsplit(raw_path)
+        upath = urllib.parse.unquote(parsed.path)
+        query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+
+        # -- admission stage (loop-side, before any body byte) ------------
+        shed_reason = None
+        tenant = None
+        if self._admitted_path(upath):
+            if self.adm.quota_rejects_put(command, upath, headers):
+                shed_reason = "quota"
+            else:
+                tenant = self.adm.tenant_of(headers)
+                if not self.adm.try_enter_tenant(tenant):
+                    shed_reason, tenant = "tenant", None
+        if shed_reason is None and not self._enqueue_ok(
+            command, upath, query
+        ):
+            shed_reason = "queue"
+        if shed_reason is not None:
+            if tenant is not None:
+                self.adm.leave_tenant(tenant)
+            self.stats.shed_inc(shed_reason)
+            self.s3.metrics.observe("Shed", 503, 0.0)
+            await self._reject(
+                writer, 503, "SlowDown",
+                "Resource requested is unreadable, please reduce your "
+                f"request rate ({shed_reason})",
+            )
+            return False
+
+        # -- handler stage -------------------------------------------------
+        h = self._handler_cls.__new__(self._handler_cls)
+        h.command = command
+        h.path = raw_path
+        h.request_version = version
+        h.requestline = requestline
+        h.headers = headers
+        h.client_address = writer.get_extra_info("peername") or ("", 0)
+        h.close_connection = self._wants_close(version, headers)
+        h.rfile = _LoopReader(self, reader)
+        h.wfile = _LoopWriter(self, writer)
+        h._plane_admitted = True
+        if (
+            version >= "HTTP/1.1"
+            and (headers.get("Expect") or "").lower() == "100-continue"
+        ):
+            h._expect_100_req = True
+
+        done = self.loop.create_future()
+
+        def _finish():
+            if not done.done():
+                done.set_result(None)
+
+        def _work():
+            try:
+                h.route()
+            except Exception as exc:  # noqa: BLE001 - connection-fatal only
+                h.close_connection = True
+                _log.debug("handler failed", extra=kv(err=str(exc)))
+            finally:
+                if tenant is not None:
+                    self.adm.leave_tenant(tenant)
+                self.loop.call_soon_threadsafe(_finish)
+
+        if self._is_streaming(command, upath, query):
+            self.pool.spawn_stream(_work)
+        else:
+            # reserved above by _enqueue_ok probing; enqueue for real
+            if not self.pool.try_submit(_work):
+                if tenant is not None:
+                    self.adm.leave_tenant(tenant)
+                self.stats.shed_inc("queue")
+                self.s3.metrics.observe("Shed", 503, 0.0)
+                await self._reject(
+                    writer, 503, "SlowDown",
+                    "Resource requested is unreadable, please reduce "
+                    "your request rate (queue)",
+                )
+                return False
+        await done
+        return not h.close_connection and not writer.is_closing()
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _parse_head(head: bytes):
+        lines = head.split(b"\r\n", 1)
+        try:
+            requestline = lines[0].decode("latin-1")
+        except UnicodeDecodeError:
+            raise ValueError("bad request line") from None
+        words = requestline.split()
+        if len(words) != 3:
+            raise ValueError("malformed request line")
+        command, raw_path, version = words
+        if not version.startswith("HTTP/"):
+            raise ValueError("bad HTTP version")
+        try:
+            headers = _hclient.parse_headers(io.BytesIO(lines[1]))
+        except Exception:  # noqa: BLE001
+            raise ValueError("malformed headers") from None
+        return requestline, command, raw_path, version, headers
+
+    @staticmethod
+    def _wants_close(version: str, headers) -> bool:
+        conn = (headers.get("Connection") or "").lower()
+        if version <= "HTTP/1.0":
+            return "keep-alive" not in conn
+        return "close" in conn
+
+    def _admitted_path(self, upath: str) -> bool:
+        """Paths subject to tenant/quota admission: the S3 plane only —
+        internode, health, and metrics endpoints bypass it exactly like
+        the global admission slot in route()."""
+        for prefix in self.s3.internode:
+            if upath.startswith(prefix + "/"):
+                return False
+        return not upath.startswith(
+            ("/minio/health/", "/minio-tpu/prometheus/")
+        )
+
+    def _enqueue_ok(self, command: str, upath: str, query) -> bool:
+        """Backlog headroom check before taking the tenant slot; the
+        real enqueue happens after the shim is built."""
+        if self._is_streaming(command, upath, query):
+            return True
+        return not self._q_full()
+
+    def _q_full(self) -> bool:
+        return self.pool._q.full()
+
+    def _is_streaming(self, command: str, upath: str, query) -> bool:
+        from . import admin as adminmod
+
+        if upath.startswith(adminmod.PREFIX + "/"):
+            tail = upath[len(adminmod.PREFIX) + 1 :]
+            if tail in ("trace", "console"):
+                return True
+        return command == "GET" and "events" in query
+
+    async def _reject(
+        self, writer, status: int, code: str, message: str
+    ) -> None:
+        """Loop-side terminal response (shed / malformed head): S3 XML
+        error document, Connection: close."""
+        err = s3errors.get(code)
+        body = xmlr.error_xml(
+            err.code, message, "/", uuid.uuid4().hex[:16]
+        )
+        reason = {408: "Request Timeout", 431: "Headers Too Large",
+                  503: "Slow Down"}.get(status, "Error")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            "Server: MinIO-TPU\r\n"
+            "Content-Type: application/xml\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode("latin-1")
+        try:
+            writer.write(head + body)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass

@@ -54,6 +54,12 @@ MAX_INTERNODE_BODY = 64 << 20
 # multi-delete bodies carry at most 10k keys (maxDeleteList)
 MAX_MULTI_DELETE_BODY = 1 << 20
 
+# request-plane mode (ROADMAP item 4): the asyncio event-loop plane is
+# the default; MINIO_TPU_SERVER=threaded keeps the thread-per-request
+# stdlib plane as the bisection oracle (house style of
+# MINIO_TPU_PARITY_PLANE=off)
+DEFAULT_SERVER_MODE = "async"
+
 
 class _ChunkedReader:
     """Decode a chunked transfer-encoded body from the socket.
@@ -203,6 +209,21 @@ class S3Server:
         # prefix -> handler(method_tail, query, body, headers)
         #           returning (status, body, extra_headers)
         self.internode: "dict[str, object]" = {}
+        # server-plane telemetry + tenant/quota admission, shared by
+        # both server modes (server/admission.py)
+        from .admission import AdmissionController, PlaneStats
+
+        self.plane_stats = PlaneStats()
+        self.admission = AdmissionController(self, self.plane_stats)
+
+        def _codec_depth() -> int:
+            from ..parallel.iopool import queued_depth
+
+            return queued_depth()
+
+        self.plane_stats.register_stage("codec", _codec_depth)
+        self._plane = None  # AsyncPlane when server_mode == "async"
+        self.server_mode = "threaded"
 
     def _requests_max(self) -> int:
         try:
@@ -320,10 +341,31 @@ class S3Server:
         class Handler(_Handler):
             s3 = server
 
+        self.tls = tlsconf.enabled()
+        mode = (
+            os.environ.get("MINIO_TPU_SERVER") or DEFAULT_SERVER_MODE
+        ).lower()
+        self.server_mode = "async" if mode == "async" else "threaded"
+        if self.server_mode == "async":
+            from . import aio
+
+            ssl_ctx = tlsconf.server_context() if self.tls else None
+            self._plane = aio.AsyncPlane(self)
+            self._plane.start(Handler, self.host, self.port, ssl_ctx)
+            self.port = self._plane.port
+            return self
+        # slow-loris guard for the threaded oracle: a per-connection
+        # socket timeout covers the header/body read (the stdlib drops
+        # the connection without a response on expiry)
+        idle = os.environ.get("MINIO_TPU_IDLE_TIMEOUT_S")
+        if idle:
+            try:
+                Handler.timeout = float(idle)
+            except ValueError:
+                pass
         self._httpd = ThreadingHTTPServer(
             (self.host, self.port), Handler
         )
-        self.tls = tlsconf.enabled()
         if self.tls:
             # TLS listener (the reference's xhttp server takes the
             # same certs for S3 and internode traffic)
@@ -342,6 +384,8 @@ class S3Server:
         ``drain_s`` (the reference's graceful shutdown,
         cmd/http/server.go:116 request draining)."""
         self.draining = True
+        if self._plane is not None:
+            self._plane.stop(drain_s)
         if self._httpd:
             self._httpd.shutdown()  # stop accepting new connections
         deadline = _time.monotonic() + drain_s
@@ -428,6 +472,9 @@ class _Handler(BaseHTTPRequestHandler):
         and signature-verified by SigV4ChunkedReader.
         """
         length = self._body_size()
+        # the framing is valid and a handler wants the body: release
+        # the deferred 100 so a waiting client starts transmitting
+        self._maybe_send_continue()
         raw = _LimitedReader(self.rfile, length)
         self._raw_body = raw
         ctx = self._auth
@@ -542,6 +589,20 @@ class _Handler(BaseHTTPRequestHandler):
     def _finish_body(self) -> None:
         """Keep-alive hygiene: drain small unread remainders, otherwise
         mark the connection dirty so it is closed rather than desynced."""
+        if getattr(self, "_expect_100", False) and not getattr(
+            self, "_continue_sent", True
+        ):
+            # the client never got its 100 and is still holding the
+            # body: there is nothing on the wire to drain — a drain
+            # here would deadlock against a conforming client, so cut
+            # the connection after the final status (RFC 7231 §5.1.1
+            # permits closing instead of reading the unsent body)
+            try:
+                if int(self.headers.get("Content-Length") or 0) > 0:
+                    self.close_connection = True
+            except ValueError:
+                self.close_connection = True
+            return
         raw = getattr(self, "_raw_body", None)
         if raw is not None:
             if raw.remaining > (1 << 20):
@@ -570,6 +631,24 @@ class _Handler(BaseHTTPRequestHandler):
             )
         )
 
+    def handle_expect_100(self):
+        """RFC 7231 §5.1.1: defer the interim 100 until a handler
+        actually solicits the body (``_maybe_send_continue``) — a
+        request rejected on its headers gets its final status with NO
+        interim 100, and the body the client never sent is never
+        "drained".  The stdlib default commits 100 at parse time,
+        before auth or framing checks have run."""
+        self._expect_100_req = True
+        return True
+
+    def _maybe_send_continue(self) -> None:
+        """First body solicitation: release the deferred interim 100 so
+        a conforming client that genuinely waits starts transmitting."""
+        if getattr(self, "_expect_100", False) and not self._continue_sent:
+            self._continue_sent = True
+            self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+            self.wfile.flush()
+
     def route(self):
         path, query = self._parse()
         self._headers_sent = False
@@ -580,6 +659,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._resp_bytes = 0
         self._t_start = None
         self._ttfb = None
+        # Expect: 100-continue deferral (one instance serves a whole
+        # keep-alive connection: the pending flag is per-request)
+        self._expect_100 = self.__dict__.pop("_expect_100_req", False)
+        self._continue_sent = False
         if self.command not in ("GET", "PUT", "POST", "DELETE", "HEAD"):
             # non-S3 verbs (PATCH, OPTIONS, PROPFIND, ...) answer the
             # S3 MethodNotAllowed document - with the body drained for
@@ -625,15 +708,38 @@ class _Handler(BaseHTTPRequestHandler):
                     self.s3.heal_routine,
                     self.s3.heal_queue,
                     audit=self.s3.audit,
+                    plane=self.s3.plane_stats.snapshot(),
                 ),
                 content_type="text/plain; version=0.0.4",
             )
+        # tenant/quota admission (server/admission.py): the async plane
+        # runs this loop-side before enqueueing; the threaded oracle
+        # runs it here so both modes shed with the same semantics
+        tenant = None
+        if not getattr(self, "_plane_admitted", False):
+            adm = self.s3.admission
+            if adm.quota_rejects_put(self.command, path, self.headers):
+                self.s3.plane_stats.shed_inc("quota")
+                self.s3.metrics.observe("Shed", 503, 0.0)
+                self.close_connection = True
+                return self._error(s3errors.get("SlowDown"), path)
+            tenant = adm.tenant_of(self.headers)
+            if not adm.try_enter_tenant(tenant):
+                self.s3.plane_stats.shed_inc("tenant")
+                self.s3.metrics.observe("Shed", 503, 0.0)
+                self.close_connection = True
+                return self._error(s3errors.get("SlowDown"), path)
         # admission control (maxClients, handler-api.go:85): overload
         # answers 503 instead of spawning unbounded work
         if not self.s3.admit():
+            if tenant is not None:
+                self.s3.admission.leave_tenant(tenant)
+            self.s3.plane_stats.shed_inc("queue")
+            self.s3.metrics.observe("Shed", 503, 0.0)
             self.close_connection = True
             self._error(s3errors.get("SlowDown"), path)
             return
+        self.s3.plane_stats.enter()
         t0 = _time.monotonic()
         self._t_start = t0
         try:
@@ -659,6 +765,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._route_authed(path, query)
         finally:
             self.s3.release()
+            self.s3.plane_stats.leave()
+            if tenant is not None:
+                self.s3.admission.leave_tenant(tenant)
             # collectAPIStats analogue: every authed-path request lands
             # in the metrics registry
             try:

@@ -126,7 +126,8 @@ class Metrics:
     # -- rendering --------------------------------------------------------
 
     def render(
-        self, object_layer=None, heal=None, queue=None, audit=None
+        self, object_layer=None, heal=None, queue=None, audit=None,
+        plane=None,
     ) -> bytes:
         """The exposition document; live gauges are sampled now."""
         out: list[str] = []
@@ -268,6 +269,36 @@ class Metrics:
                 "miniotpu_audit_entries_dropped_total", "counter",
                 "Audit entries lost to target write failures",
                 [({}, getattr(audit, "dropped", 0))],
+            )
+        if plane is not None:
+            # server-plane admission/backpressure families (PlaneStats
+            # snapshot, server/admission.py); shed reasons are
+            # zero-filled so the label set is stable across scrapes
+            emit(
+                "miniotpu_server_inflight_requests", "gauge",
+                "Admitted S3 requests currently executing",
+                [({}, plane.get("inflight", 0))],
+            )
+            emit(
+                "miniotpu_server_stage_queue_depth", "gauge",
+                "Requests waiting per server-plane stage",
+                [
+                    ({"stage": stage}, depth)
+                    for stage, depth in sorted(
+                        plane.get("stage_depth", {}).items()
+                    )
+                ],
+            )
+            from .admission import SHED_REASONS
+
+            shed = plane.get("shed", {})
+            emit(
+                "miniotpu_server_shed_total", "counter",
+                "Requests shed by admission control, by reason",
+                [
+                    ({"reason": r}, shed.get(r, 0))
+                    for r in SHED_REASONS
+                ],
             )
         return ("\n".join(out) + "\n").encode()
 

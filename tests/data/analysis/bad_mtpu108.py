@@ -1,0 +1,31 @@
+"""Fixture: event-loop-blocking calls in async defs (MTPU108).
+
+Linted under the rel_path ``minio_tpu/server/bad_mtpu108.py`` so the
+server-plane loop scope applies.  Each offending line carries a
+``# VIOLATION: MTPU###`` marker; the test derives the expected
+(rule, line) set from these markers.
+"""
+
+import time
+
+import time as _time
+
+
+async def handle_conn(sock, fut, ev):
+    time.sleep(0.5)  # VIOLATION: MTPU108
+    data = sock.recv(4096)  # VIOLATION: MTPU108
+    sock.sendall(data)  # VIOLATION: MTPU108
+    result = fut.result()  # VIOLATION: MTPU108
+    ev.wait()  # VIOLATION: MTPU108
+    return result
+
+
+async def shed_slowly(writer):
+    _time.sleep(0.01)  # VIOLATION: MTPU108
+    writer.close()
+
+
+async def forgot_await(ev):
+    # an asyncio.Event.wait() without await never even runs — same bug,
+    # same rule
+    ev.wait()  # VIOLATION: MTPU108

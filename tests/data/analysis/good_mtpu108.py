@@ -1,0 +1,38 @@
+"""Fixture: non-blocking forms MTPU108 must NOT flag.
+
+Linted under the rel_path ``minio_tpu/server/good_mtpu108.py``: awaited
+primitives, asyncio-wrapped coroutines, and the sync-def worker-side
+bridge (run_coroutine_threadsafe(...).result()) are all sanctioned.
+"""
+
+import asyncio
+
+
+async def handle_conn(reader, writer, ev):
+    data = await asyncio.wait_for(reader.read(4096), 5.0)
+    writer.write(data)
+    await writer.drain()
+    await ev.wait()
+    await asyncio.sleep(0.01)
+    return data
+
+
+def bridge_read(loop, reader):
+    # sync def: the blocking .result() here runs on a WORKER thread —
+    # this is the executor-bridge seam, not a loop stall
+    fut = asyncio.run_coroutine_threadsafe(reader.read(4096), loop)
+    return fut.result()
+
+
+async def waits(tasks, ev):
+    await asyncio.wait_for(ev.wait(), 1.0)
+    await asyncio.wait(tasks)
+
+
+async def offloads(loop, fut):
+    def on_worker():
+        # innermost def is sync: it runs wherever it is called, which
+        # for the bridge is a worker thread
+        return fut.result()
+
+    return await loop.run_in_executor(None, on_worker)

@@ -247,6 +247,65 @@ def test_mtpu107_silent_outside_parity_scope():
     )
 
 
+# -- MTPU108: event-loop-blocking lint is scoped to server/ -------------
+#
+# Like MTPU107, the scope is path-keyed (async defs under
+# minio_tpu/server/), so the fixtures are linted under a server/
+# rel_path instead of riding the shared param lists.
+
+
+def test_bad_mtpu108_exact_findings_under_server_scope():
+    expected = _expected_markers("bad_mtpu108.py")
+    assert expected, "bad_mtpu108.py declares no VIOLATION markers"
+    got = {
+        (f.rule, f.line)
+        for f in _lint_fixture(
+            "bad_mtpu108.py", rel_path="minio_tpu/server/bad_mtpu108.py"
+        )
+    }
+    assert got == expected
+
+
+def test_good_mtpu108_clean_under_server_scope():
+    found = _lint_fixture(
+        "good_mtpu108.py", rel_path="minio_tpu/server/good_mtpu108.py"
+    )
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_mtpu108_silent_outside_server_scope():
+    """The same source linted under codec/ raises no MTPU108 (the rule
+    keys on the request plane, not on async syntax in general)."""
+    found = _lint_fixture(
+        "bad_mtpu108.py", rel_path="minio_tpu/codec/bad_mtpu108.py"
+    )
+    assert not any(f.rule == "MTPU108" for f in found), "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_mtpu108_fires_on_the_shipped_aio_module_if_seeded():
+    """Canary: injecting a time.sleep into an async def of the real
+    server/aio.py source is caught by the gate."""
+    import os as _os
+
+    aio_path = _os.path.join(
+        analysis.REPO_ROOT, "minio_tpu", "server", "aio.py"
+    )
+    with open(aio_path, encoding="utf-8") as fh:
+        src = fh.read()
+    seeded = src.replace(
+        "    async def _serve_conn(",
+        "    async def _seeded(self):\n"
+        "        time.sleep(1)\n\n"
+        "    async def _serve_conn(",
+        1,
+    )
+    assert seeded != src
+    found = lint_source("minio_tpu/server/aio.py", seeded)
+    assert any(f.rule == "MTPU108" for f in found)
+
+
 def test_noqa_suppresses_matching_rule():
     found = _lint_fixture("noqa_suppressed.py")
     assert found == [], "\n".join(f.render() for f in found)

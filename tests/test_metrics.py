@@ -447,3 +447,48 @@ def test_batcher_occupancy_counters():
     assert after["jobs"] >= ks_before["jobs"] + 2
     assert after["blocks"] >= ks_before["blocks"] + 4
     assert after["wait_seconds"] >= ks_before["wait_seconds"]
+
+
+def test_server_plane_render_unit():
+    """render(plane=...) emits the three request-plane families with
+    zero-filled shed reasons, straight from a stats snapshot."""
+    from minio_tpu.server.admission import SHED_REASONS, PlaneStats
+
+    stats = PlaneStats()
+    stats.register_stage("parse", lambda: 3)
+    stats.register_stage("handler", lambda: 1)
+    stats.enter()
+    stats.shed_inc("queue")
+    stats.shed_inc("queue")
+    m = Metrics()
+    families = parse_exposition(
+        m.render(plane=stats.snapshot()).decode()
+    )
+    fam = get_family(families, "miniotpu_server_inflight_requests")
+    assert fam["type"] == "gauge"
+    assert fam["samples"][0][2] == 1.0
+    fam = get_family(families, "miniotpu_server_stage_queue_depth")
+    depths = {lab["stage"]: v for _n, lab, v in fam["samples"]}
+    assert depths == {"parse": 3.0, "handler": 1.0}
+    fam = get_family(families, "miniotpu_server_shed_total")
+    assert fam["type"] == "counter"
+    sheds = {lab["reason"]: v for _n, lab, v in fam["samples"]}
+    assert set(sheds) == set(SHED_REASONS)  # zero-filled
+    assert sheds["queue"] == 2.0
+    assert sheds["quota"] == 0.0 and sheds["tenant"] == 0.0
+
+
+def test_live_server_plane_families(server, client):
+    """The live scrape carries the request-plane families: inflight
+    counts this very scrape, and all pipeline stages report a depth."""
+    families = parse_exposition(_scrape(client))
+    fam = get_family(families, "miniotpu_server_inflight_requests")
+    # the scrape route renders before the inflight accounting point,
+    # so it does not count itself
+    assert fam["samples"][0][2] >= 0.0
+    fam = get_family(families, "miniotpu_server_stage_queue_depth")
+    stages = {lab["stage"] for _n, lab, _v in fam["samples"]}
+    assert {"parse", "handler", "codec"} <= stages, stages
+    fam = get_family(families, "miniotpu_server_shed_total")
+    reasons = {lab["reason"] for _n, lab, _v in fam["samples"]}
+    assert reasons == {"queue", "quota", "tenant"}
