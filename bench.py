@@ -1000,6 +1000,166 @@ def bench_concurrency_sweep(
     return results
 
 
+def bench_multichip(
+    chip_counts=(1, 2, 4),
+    policies=("span", "route", "auto"),
+    small_batches=(1, 2, 4),
+    large_batches=(16, 32),
+    clients: int = 4,
+    k: int = 8,
+    m: int = 4,
+    length: int = 4096,
+) -> dict:
+    """Placement sweep (--multichip): chips x batch x policy through the
+    production seam (BatchingBackend over TpuBackend pinned to a device
+    slice).  Each client encodes its own object-size class (distinct
+    lengths -> independent merged groups), which is exactly the workload
+    the router exists for: at small batch, ``span`` lowers every group
+    to a collective shard_map across all chips and serializes groups on
+    the dispatcher thread, while ``route`` runs them concurrently on
+    single-chip submeshes through the fused jit path.  Bit-identity vs
+    the CPU reference codec is a hard gate on every cell.
+
+    Forces the virtual-CPU platform (same contract as
+    __graft_entry__.dryrun_multichip: must run before jax initializes).
+    """
+    import os
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import threading
+
+    import jax
+
+    from minio_tpu.codec.backend import CpuBackend, TpuBackend
+    from minio_tpu.codec.batcher import BatchingBackend
+    from minio_tpu.codec.telemetry import KERNEL_STATS
+
+    ref = CpuBackend()
+    # one object-size class per client, word-aligned, close enough that
+    # blocks/s stays comparable across clients
+    lengths = [length + 64 * i for i in range(clients)]
+    batches = tuple(small_batches) + tuple(large_batches)
+
+    def _run_round(backend, batch, n_ops, check=False):
+        """All clients concurrently; returns wall seconds."""
+        errs = []
+        start = threading.Barrier(clients + 1)
+
+        def client(idx):
+            rng = np.random.default_rng(1000 * idx + batch)
+            data = rng.integers(
+                0, 256, (batch, k, lengths[idx]), dtype=np.uint8
+            )
+            start.wait()
+            for _ in range(n_ops):
+                parity, digests = backend.encode(data, m)
+            if check:
+                ep, ed = ref.encode(data, m)
+                if not (
+                    np.array_equal(np.asarray(parity), ep)
+                    and np.array_equal(np.asarray(digests), ed)
+                ):
+                    errs.append(idx)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        start.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise AssertionError(
+                f"bit-identity mismatch vs CPU codec, clients {errs}"
+            )
+        return wall
+
+    sweep = []
+    for chips in chip_counts:
+        devices = tuple(jax.devices()[:chips])
+        for policy in policies:
+            os.environ["MINIO_TPU_PLACEMENT"] = policy
+            os.environ["MINIO_TPU_SUBMESH_DEVICES"] = "1"
+            backend = BatchingBackend(
+                TpuBackend(devices=devices), deadline_s=0.002
+            )
+            try:
+                for batch in batches:
+                    n_ops = max(3, 24 // batch)
+                    # warmup compiles every client geometry + checks
+                    # bit-identity, then the timed round
+                    _run_round(backend, batch, 1, check=True)
+                    KERNEL_STATS.reset()
+                    wall = _run_round(backend, batch, n_ops)
+                    snap = KERNEL_STATS.snapshot()
+                    blocks = batch * n_ops * clients
+                    sweep.append(
+                        {
+                            "chips": chips,
+                            "policy": policy,
+                            "batch": batch,
+                            "blocks_per_s": round(blocks / wall, 1),
+                            "wall_s": round(wall, 4),
+                            "placement": snap["placement"],
+                            "submesh_depth_hwm": {
+                                s["submesh"]: s["depth_hwm"]
+                                for s in snap["submeshes"]
+                            },
+                            "bit_identical": True,
+                        }
+                    )
+            finally:
+                backend.shutdown()
+    os.environ.pop("MINIO_TPU_PLACEMENT", None)
+    os.environ.pop("MINIO_TPU_SUBMESH_DEVICES", None)
+
+    def _cell(chips, policy, batch):
+        for row in sweep:
+            if (row["chips"], row["policy"], row["batch"]) == (
+                chips, policy, batch,
+            ):
+                return row
+        return None
+
+    top = max(chip_counts)
+    small, large = small_batches[0], large_batches[-1]
+    acceptance = {}
+    for pol in ("route", "auto"):
+        a, s = _cell(top, pol, small), _cell(top, "span", small)
+        if a and s:
+            acceptance[f"small_batch_{pol}_vs_span_{top}chip"] = round(
+                a["blocks_per_s"] / s["blocks_per_s"], 2
+            )
+    a, s = _cell(top, "auto", large), _cell(top, "span", large)
+    if a and s:
+        acceptance[f"large_batch_auto_vs_span_{top}chip"] = round(
+            a["blocks_per_s"] / s["blocks_per_s"], 2
+        )
+    return {
+        "metric": (
+            f"multi-chip placement sweep (EC {k}+{m}, "
+            f"{clients} clients, distinct object-size classes)"
+        ),
+        "geometry": {"k": k, "m": m, "lengths": lengths},
+        "chip_counts": list(chip_counts),
+        "policies": list(policies),
+        "sweep": sweep,
+        "acceptance": acceptance,
+        "bit_identical_all_cells": True,
+    }
+
+
 def main() -> None:
     import argparse
     import os
@@ -1041,7 +1201,17 @@ def main() -> None:
         "keep-alive clients, GET+PUT p50/p99 + shed counts, async "
         "event-loop plane vs threaded oracle) and print its JSON",
     )
+    ap.add_argument(
+        "--multichip",
+        action="store_true",
+        help="run ONLY the multi-chip placement sweep (1/2/4 chips x "
+        "batch x span/route/auto through the batcher's submesh router, "
+        "bit-identity gated) and print its JSON (MULTICHIP_r06 schema)",
+    )
     args = ap.parse_args()
+    if args.multichip:
+        print(json.dumps(bench_multichip(), indent=1))
+        return
     if args.concurrency:
         print(json.dumps(bench_concurrency_sweep(), indent=1))
         return

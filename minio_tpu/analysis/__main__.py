@@ -32,6 +32,7 @@ PASS_TRIGGER_PREFIXES = {
     "contracts": (
         "minio_tpu/ops/",
         "minio_tpu/codec/backend.py",
+        "minio_tpu/parallel/",
         "minio_tpu/analysis/kernel_contracts.py",
     ),
     "abi": (

@@ -57,6 +57,12 @@ RULES: "dict[str, str]" = {
         "coroutine stalls every connection; route blocking work through "
         "the worker-pool bridge)"
     ),
+    "MTPU109": (
+        "hand-written PartitionSpec literal in minio_tpu/parallel or "
+        "minio_tpu/ops outside parallel/rules.py: shardings must come "
+        "from the partition-rule table (rules.spec_for), the single "
+        "source of truth the compile seam fingerprints"
+    ),
     "MTPU201": "kernel contract: wrong output dtype from a jitted entry point",
     "MTPU202": "kernel contract: wrong output shape from a jitted entry point",
     "MTPU203": (

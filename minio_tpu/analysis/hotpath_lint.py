@@ -54,6 +54,14 @@ _PARITY_SCOPE_PREFIXES = ("minio_tpu/ops/",)
 _PARITY_SCOPE_FILES = ("minio_tpu/codec/backend.py",)
 _PARITY_SEAM_RE = re.compile(r"(_end$|drain)")
 
+# MTPU109: hand-written PartitionSpec literals.  parallel/rules.py is
+# the single source of truth for shardings (pattern -> PartitionSpec,
+# fingerprinted into the compile-seam cache key); a spec literal
+# anywhere else in the mesh/ops layers silently forks that truth.
+_SPEC_SCOPE_PREFIXES = ("minio_tpu/parallel/", "minio_tpu/ops/")
+_SPEC_EXEMPT_FILES = ("minio_tpu/parallel/rules.py",)
+_SPEC_CTORS = {"PartitionSpec", "P", "PS"}
+
 _METRIC_NAME_RE = re.compile(r"^miniotpu_[a-z0-9_]+$")
 _LABEL_KEY_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 _METRIC_TYPES = {"counter", "gauge", "histogram"}
@@ -164,6 +172,10 @@ class _Linter(ast.NodeVisitor):
             or rel_path in _PARITY_SCOPE_FILES
         )
         self.loop_scope = rel_path.startswith(_LOOP_SCOPE_PREFIXES)
+        self.spec_scope = (
+            rel_path.startswith(_SPEC_SCOPE_PREFIXES)
+            and rel_path not in _SPEC_EXEMPT_FILES
+        )
         self.findings: "list[Finding]" = []
         # stack of (func_name, jit_static_names or None)
         self._funcs: "list[tuple[str, set | None]]" = []
@@ -251,9 +263,29 @@ class _Linter(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         self._check_sync(node)
         self._check_parity_readback(node)
+        self._check_partition_literal(node)
         self._check_metric_emit(node)
         self._check_loop_block(node)
         self.generic_visit(node)
+
+    def _check_partition_literal(self, node: ast.Call) -> None:
+        """MTPU109: PartitionSpec literal outside parallel/rules.py."""
+        if not self.spec_scope:
+            return
+        fn = node.func
+        last = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if last not in _SPEC_CTORS:
+            return
+        self._emit(
+            "MTPU109",
+            node,
+            f"hand-written {last}(...) sharding literal outside "
+            "parallel/rules.py; name the plane and resolve it through "
+            "rules.spec_for so the partition-rule table stays the "
+            "single source of truth",
+        )
 
     def _check_loop_block(self, node: ast.Call) -> None:
         """MTPU108: blocking call on the event-loop thread."""

@@ -52,9 +52,13 @@ def _ops_modules():
     # codec.backend is watched too: the PR 4 fused-codec seams
     # (encode_and_hash / reconstruct_and_verify) route through backend
     # objects, and a jitted wrapper landing there without a contract
-    # must fail MTPU204 the same as one in ops/.
+    # must fail MTPU204 the same as one in ops/.  parallel.mesh/rules
+    # register their kernels with the compile seam instead of module
+    # attrs; watching them here catches a stray module-level jit, and
+    # the seam registry gets its own MTPU204 closure in run().
     from minio_tpu.codec import backend
     from minio_tpu.ops import codec_step, hash as phash, rs, rs_pallas
+    from minio_tpu.parallel import mesh, rules
 
     return {
         "rs": rs,
@@ -62,6 +66,8 @@ def _ops_modules():
         "codec_step": codec_step,
         "hash": phash,
         "backend": backend,
+        "mesh": mesh,
+        "rules": rules,
     }
 
 
@@ -426,6 +432,164 @@ def run() -> "list[Finding]":
             c.dtype(out, "uint8", "mxu parity bytes")
         except Exception as e:
             c.fail(e)
+
+    # ---- parallel/mesh.py: compile-seam mesh kernels --------------------
+    #
+    # Mesh kernels are not module-level jitted attrs: they are built per
+    # geometry through the rules.py compile seam.  Contracts abstract-
+    # eval each registered kind through BOTH lowerings (jit+NamedSharding
+    # and shard_map) on a 1-device probe mesh — geometry-independent
+    # shape/dtype truth that holds on any host, mirroring how the ops/
+    # kernels are checked without an accelerator.
+
+    from minio_tpu.parallel import mesh as pmesh, rules as prules
+
+    probe = pmesh.make_mesh(jax.devices()[:1], stripe=1, shard=1)
+    mesh_checked: "set[str]" = set()
+
+    def mesh_ctx(kind):
+        kd = prules.kernel_def(kind)
+        return ctx(
+            kd.build_local or kd.build_global,
+            "minio_tpu/parallel/mesh.py",
+        )
+
+    def mesh_modes(kind):
+        kd = prules.kernel_def(kind)
+        modes = []
+        if kd.build_global is not None:
+            modes.append("jit")
+        if kd.build_local is not None:
+            modes.append("shard_map")
+        return modes
+
+    def mesh_eval(kind, mode, args, statics):
+        fn = prules.compile_kernel(kind, probe, force_mode=mode, **statics)
+        return fn.eval_shape(*args)
+
+    mesh_checked.add("sharded_encode")
+    c = mesh_ctx("sharded_encode")
+    for k, m, L in CONFIG_GRID:
+        for mode in mesh_modes("sharded_encode"):
+            c.config = cfg_str(k, m, L) + f" [{mode}]"
+            try:
+                out = mesh_eval(
+                    "sharded_encode", mode,
+                    (S((_BATCH, k, L), u8),), dict(k=k, m=m),
+                )
+                c.shape(out, (_BATCH, m, L), "mesh parity bytes")
+                c.dtype(out, "uint8", "mesh parity bytes")
+            except Exception as e:
+                c.fail(e)
+
+    mesh_checked.add("sharded_encode_seq")
+    c = mesh_ctx("sharded_encode_seq")
+    for k, m, L in CONFIG_GRID:
+        for mode in mesh_modes("sharded_encode_seq"):
+            c.config = cfg_str(k, m, L) + f" [{mode}]"
+            try:
+                out = mesh_eval(
+                    "sharded_encode_seq", mode,
+                    (S((k, L), u8),), dict(k=k, m=m),
+                )
+                c.shape(out, (m, L), "seq parity bytes")
+                c.dtype(out, "uint8", "seq parity bytes")
+            except Exception as e:
+                c.fail(e)
+
+    mesh_checked.add("mesh_encode_hash")
+    c = mesh_ctx("mesh_encode_hash")
+    for k, m, L in CONFIG_GRID:
+        w = L // 4
+        for mode in mesh_modes("mesh_encode_hash"):
+            c.config = cfg_str(k, m, L) + f" [{mode}]"
+            try:
+                parity, ddig, pdig = mesh_eval(
+                    "mesh_encode_hash", mode,
+                    (S((_BATCH, k, w), u32),),
+                    dict(k=k, m=m, shard_len=L),
+                )
+                c.shape(parity, (_BATCH, m, w), "mesh parity words")
+                c.dtype(parity, "uint32", "mesh parity words")
+                c.shape(ddig, (_BATCH, k, 8), "mesh data digests")
+                c.dtype(ddig, "uint32", "mesh data digests")
+                c.shape(pdig, (_BATCH, m, 8), "mesh parity digests")
+                c.dtype(pdig, "uint32", "mesh parity digests")
+            except Exception as e:
+                c.fail(e)
+
+    mesh_checked.add("mesh_reconstruct")
+    c = mesh_ctx("mesh_reconstruct")
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        # worst admissible erasure: all m losses fall on data shards
+        idx = tuple(range(m, n))[:k]
+        for mode in mesh_modes("mesh_reconstruct"):
+            c.config = cfg_str(k, m, L) + f" [{mode}]"
+            try:
+                out = mesh_eval(
+                    "mesh_reconstruct", mode,
+                    (S((_BATCH, k, w), u32),),
+                    dict(k=k, m=m, idx=idx),
+                )
+                c.shape(out, (_BATCH, k, w), "mesh recon words")
+                c.dtype(out, "uint32", "mesh recon words")
+                # MTPU203: mesh encode -> reconstruct round-trip
+                parity, _, _ = mesh_eval(
+                    "mesh_encode_hash", mesh_modes("mesh_encode_hash")[0],
+                    (S((_BATCH, k, w), u32),),
+                    dict(k=k, m=m, shard_len=L),
+                )
+                surv = S((_BATCH, parity.shape[1] + (k - m), w), parity.dtype)
+                rt = mesh_eval(
+                    "mesh_reconstruct", mode,
+                    (surv,), dict(k=k, m=m, idx=idx),
+                )
+                c.expect(
+                    "MTPU203",
+                    (tuple(rt.shape), str(rt.dtype)),
+                    ((_BATCH, k, w), "uint32"),
+                    "mesh encode->reconstruct round-trip (words)",
+                )
+            except Exception as e:
+                c.fail(e)
+
+    mesh_checked.add("mesh_digest")
+    c = mesh_ctx("mesh_digest")
+    for k, m, L in CONFIG_GRID:
+        w = L // 4
+        for mode in mesh_modes("mesh_digest"):
+            c.config = cfg_str(k, m, L) + f" [{mode}]"
+            try:
+                out = mesh_eval(
+                    "mesh_digest", mode,
+                    (S((_BATCH, w), u32),), dict(shard_len=L),
+                )
+                c.shape(out, (_BATCH, 8), "mesh digests")
+                c.dtype(out, "uint32", "mesh digests")
+            except Exception as e:
+                c.fail(e)
+
+    # seam-registry closure: a kernel registered with the compile seam
+    # but missing a contract block above fails MTPU204 the same way a
+    # new module-level jitted entry point does
+    for kind in prules.registered_kernels():
+        if kind not in mesh_checked:
+            kd = prules.kernel_def(kind)
+            path, line = _anchor(
+                kd.build_local or kd.build_global,
+                "minio_tpu/parallel/mesh.py",
+            )
+            findings.append(
+                Finding(
+                    "MTPU204",
+                    path,
+                    line,
+                    f"mesh kernel {kind!r} registered with the compile "
+                    "seam has no contract check; add one in "
+                    "minio_tpu/analysis/kernel_contracts.py",
+                )
+            )
 
     # ---- coverage closure (MTPU204) -------------------------------------
 

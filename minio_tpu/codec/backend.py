@@ -397,6 +397,13 @@ class CodecBackend:
         backend keeps nothing device-resident)."""
         return 0.0
 
+    def placement_router(self):
+        """Submesh router for multi-chip placement, or None when the
+        backend has no device set to carve (host backends, single
+        device).  The batcher feature-detects this seam to route
+        independent merged batches to disjoint submeshes."""
+        return None
+
 
 class TpuBackend(CodecBackend):
     """Device backend: single-chip fused passes, mesh-parallel when the
@@ -410,27 +417,55 @@ class TpuBackend(CodecBackend):
     name = "tpu"
     fused_encode = True  # ops/codec_step fuses encode+hash on device
 
-    def __init__(self):
-        self._meshes: dict[tuple[int, int], object] = {}
+    def __init__(self, devices=None):
+        # devices=None -> every visible device; an explicit tuple pins
+        # the backend to a slice of the machine (bench chip sweeps)
+        self._devices = tuple(devices) if devices is not None else None
+        self._meshes: dict[tuple, object] = {}
+        self._router = None
+        self._router_mu = threading.Lock()
 
-    def _mesh_for(self, batch: int, k: int):
-        """Pick a mesh for this call's geometry, or None for single-device."""
+    def _base_devices(self) -> tuple:
         import jax
 
+        if self._devices is not None:
+            return self._devices
+        return tuple(jax.devices())
+
+    def _mesh_for(self, batch: int, k: int):
+        """Pick a mesh for this call's geometry, or None for single-device.
+
+        A submesh routed by the batcher (parallel.rules.placed) narrows
+        the device set for this thread; otherwise the full base set
+        spans.
+        """
         if os.environ.get("MINIO_MESH", "1") == "0":
             return None
-        devices = jax.devices()
+        from ..parallel import mesh as pm, rules as prules
+
+        devices = prules.current_placement() or self._base_devices()
         if len(devices) <= 1:
             return None
-        from ..parallel import mesh as pm
-
         stripe, shard = pm.pick_axes(len(devices), batch, k)
-        key = (stripe, shard)
+        # key on device ids, not the tuple of Device objects: cheap and
+        # stable across jax.devices() calls
+        key = (tuple(int(d.id) for d in devices), stripe, shard)
         m = self._meshes.get(key)
         if m is None:
-            m = pm.make_mesh(devices, stripe=stripe, shard=shard)
+            m = pm.make_mesh(list(devices), stripe=stripe, shard=shard)
             self._meshes[key] = m
         return m
+
+    def placement_router(self):
+        devices = self._base_devices()
+        if len(devices) <= 1:
+            return None
+        with self._router_mu:
+            if self._router is None:
+                from ..parallel import rules as prules
+
+                self._router = prules.PlacementRouter(devices)
+            return self._router
 
     def encode(self, data, parity_shards):
         return self.encode_end(self.encode_begin(data, parity_shards))

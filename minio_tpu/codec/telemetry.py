@@ -63,6 +63,11 @@ class KernelStats:
         # The parity-plane PUT restructure exists to drive the parity
         # row of this table to the post-ack drain band only
         self._d2h: "dict[str, list]" = {}
+        # submesh placement: outcome ("span"|"route") -> batches, and
+        # per-submesh in-flight depth (current + high-water mark)
+        self._placement: "dict[str, int]" = {}
+        self._submesh_depth: "dict[str, int]" = {}
+        self._submesh_depth_hwm: "dict[str, int]" = {}
 
     # -- recording --------------------------------------------------------
 
@@ -138,6 +143,19 @@ class KernelStats:
             if depth > self._iopool_depth_hwm:
                 self._iopool_depth_hwm = depth
 
+    def record_placement(self, outcome: str) -> None:
+        """One batch placement decision (outcome = span|route)."""
+        with self._mu:
+            self._placement[outcome] = self._placement.get(outcome, 0) + 1
+
+    def record_submesh_depths(self, depths: "dict[str, int]") -> None:
+        """Live per-submesh queue depths from the placement router."""
+        with self._mu:
+            for name, depth in depths.items():
+                self._submesh_depth[name] = depth
+                if depth > self._submesh_depth_hwm.get(name, 0):
+                    self._submesh_depth_hwm[name] = depth
+
     # -- reading ----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -189,6 +207,20 @@ class KernelStats:
                         self._stages.items()
                     )
                 ],
+                "placement": {
+                    outcome: self._placement.get(outcome, 0)
+                    for outcome in ("span", "route")
+                },
+                "submeshes": [
+                    {
+                        "submesh": name,
+                        "depth": self._submesh_depth.get(name, 0),
+                        "depth_hwm": hwm,
+                    }
+                    for name, hwm in sorted(
+                        self._submesh_depth_hwm.items()
+                    )
+                ],
                 "iopool": {
                     "queues": [
                         {
@@ -220,6 +252,9 @@ class KernelStats:
             self._iopool_slowest_s = 0.0
             self._hedge.clear()
             self._d2h.clear()
+            self._placement.clear()
+            self._submesh_depth.clear()
+            self._submesh_depth_hwm.clear()
 
 
 def _parity_cache_stats() -> dict:
@@ -327,6 +362,11 @@ class InstrumentedBackend(CodecBackend):
 
     def parity_cache_pressure(self) -> float:
         return self.inner.parity_cache_pressure()
+
+    def placement_router(self):
+        # explicit delegation (this wrapper has no __getattr__): the
+        # batcher feature-detects the routing seam through it
+        return self.inner.placement_router()
 
     def digest(self, shards):
         return self._timed(

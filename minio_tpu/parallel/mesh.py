@@ -16,32 +16,27 @@ TPU-native analogue maps those strategies onto a jax.sharding.Mesh:
   devices; each device computes a partial parity (XOR of its terms) and
   partials are combined with a recursive-doubling XOR all-reduce over ICI.
 
+Shardings are not written here: every entry point declares its operand
+planes by name and `parallel.rules` resolves them (PARTITION_RULES) and
+picks the lowering (shard_map when the shard axis needs the XOR
+all-reduce, jit+NamedSharding for collective-free geometries) behind one
+compile cache keyed on device ids rather than Mesh identity.
+
 All entry points work under jit/shard_map with static shapes.
 """
 
 from __future__ import annotations
-
-import functools
-import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# jax.shard_map only exists as a top-level alias in newer releases;
-# older ones (e.g. 0.4.x) ship it under jax.experimental.shard_map with
-# the replication check spelled `check_rep` instead of `check_vma`
-_shard_map = getattr(jax, "shard_map", None)
-if _shard_map is None:
-    from jax.experimental.shard_map import shard_map as _exp_shard_map
-
-    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
-        return _exp_shard_map(
-            f, mesh, in_specs, out_specs, check_rep=check_vma
-        )
-
 from ..ops import gf, rs
+from . import rules
+
+# compat alias: tests and older callers import the shim from here
+_shard_map = rules._shard_map
 
 
 def make_mesh(
@@ -107,6 +102,201 @@ def _partial_parity(
     return rs._encode_words(local_data_words, matrix_cols)
 
 
+def _col_blocks(matrix: np.ndarray, shard_n: int) -> np.ndarray:
+    """Split a generator/reconstruction matrix into per-shard-device columns."""
+    k = matrix.shape[1]
+    k_local = k // shard_n
+    return np.stack(
+        [matrix[:, s * k_local : (s + 1) * k_local] for s in range(shard_n)]
+    )  # (shard_n, rows, k_local) - static stack, dynamic row pick
+
+
+def put_sharded(mesh: Mesh, x: np.ndarray, spec: P) -> jax.Array:
+    """Place a host array onto the mesh with the given partition spec."""
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def _pad_batch(arr: np.ndarray, rows: int) -> np.ndarray:
+    """Zero-pad the leading axis to ``rows`` with a single allocation.
+
+    (np.concatenate would reallocate AND copy the batch through a
+    temporary; here the only traffic is one memcpy into fresh zeros, and
+    the unpadded case returns the input untouched.)
+    """
+    if arr.shape[0] == rows:
+        return arr
+    out = np.zeros((rows,) + arr.shape[1:], dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Kernel bodies, registered with the rules.py compile seam
+# ---------------------------------------------------------------------------
+#
+# Each kernel kind has up to two builders: `build_local` (per-device body
+# for shard_map; may use the XOR all-reduce over "shard") and
+# `build_global` (whole-array program for jit+NamedSharding; XLA
+# partitions it, valid because it needs no hand-rolled collective).
+
+
+def _encode_local(mesh: Mesh, k: int, m: int):
+    shard_n = mesh.shape["shard"]
+    col_blocks = _col_blocks(gf.parity_matrix(k, m), shard_n)
+
+    def step(local: jax.Array) -> jax.Array:
+        # local: (B_local, k_local, length) uint8
+        idx = jax.lax.axis_index("shard")
+        words = rs.bytes_to_words(local)
+        my_cols = jnp.asarray(col_blocks)[idx]
+        partial = jax.vmap(
+            lambda w: rs._matmul_words_dynamic(w, my_cols)
+        )(words)
+        total = xor_allreduce(partial, "shard")
+        return rs.words_to_bytes(total)
+
+    return step
+
+
+def _encode_global(mesh: Mesh, k: int, m: int):
+    matrix = gf.parity_matrix(k, m)
+
+    def step(data: jax.Array) -> jax.Array:
+        # data: (B, k, length) uint8
+        words = rs.bytes_to_words(data)
+        parity = jax.vmap(lambda w: rs._encode_words(w, matrix))(words)
+        return rs.words_to_bytes(parity)
+
+    return step
+
+
+def _encode_seq_global(mesh: Mesh, k: int, m: int):
+    matrix = gf.parity_matrix(k, m)
+
+    def step(data: jax.Array) -> jax.Array:
+        # data: (k, length) uint8, length sharded; RS is column-local
+        words = rs.bytes_to_words(data)
+        return rs.words_to_bytes(rs._encode_words(words, matrix))
+
+    return step
+
+
+def _encode_hash_local(mesh: Mesh, k: int, m: int, shard_len: int):
+    from ..ops import hash as phash
+
+    shard_n = mesh.shape["shard"]
+    col_blocks = _col_blocks(gf.parity_matrix(k, m), shard_n)
+
+    def step(local: jax.Array):
+        # local: (B_local, k_local, w)
+        idx = jax.lax.axis_index("shard")
+        my_cols = jnp.asarray(col_blocks)[idx]
+        partial = jax.vmap(
+            lambda wds: rs._matmul_words_dynamic(wds, my_cols)
+        )(local)
+        parity = xor_allreduce(partial, "shard")  # (B_local, m, w)
+        ddig = phash.phash256_words_batched(local, shard_len)
+        pdig = phash.phash256_words_batched(parity, shard_len)
+        return parity, ddig, pdig
+
+    return step
+
+
+def _encode_hash_global(mesh: Mesh, k: int, m: int, shard_len: int):
+    from ..ops import codec_step
+
+    def step(words: jax.Array):
+        # whole stripes are device-local on a stripe-only mesh: run the
+        # fused single-device kernel (static matrix -> Pallas on TPU)
+        # instead of the dynamic bit-walk
+        parity, digests = codec_step.encode_and_hash_words(
+            words, m, shard_len
+        )
+        return parity, digests[:, :k], digests[:, k:]
+
+    return step
+
+
+def _reconstruct_local(mesh: Mesh, k: int, m: int, idx: tuple[int, ...]):
+    shard_n = mesh.shape["shard"]
+    rm = gf.reconstruction_matrix(k, m, idx)  # (k, k) survivors -> data
+    col_blocks = _col_blocks(rm, shard_n)
+
+    def step(local: jax.Array):
+        # local: (B_local, k_local, w) compacted survivor rows
+        dev = jax.lax.axis_index("shard")
+        my_cols = jnp.asarray(col_blocks)[dev]
+        partial = jax.vmap(
+            lambda wds: rs._matmul_words_dynamic(wds, my_cols)
+        )(local)
+        return xor_allreduce(partial, "shard")
+
+    return step
+
+
+def _reconstruct_global(mesh: Mesh, k: int, m: int, idx: tuple[int, ...]):
+    rm = gf.reconstruction_matrix(k, m, idx)
+
+    def step(surv: jax.Array):
+        # surv: (B, k, w) compacted survivor rows
+        return jax.vmap(lambda wds: rs._matmul_static(wds, rm))(surv)
+
+    return step
+
+
+def _digest_global(mesh: Mesh, shard_len: int):
+    from ..ops import hash as phash
+
+    def step(rows: jax.Array):
+        # rows: (R, w) flattened shard rows; embarrassingly parallel
+        return phash.phash256_words_batched(rows, shard_len)
+
+    return step
+
+
+rules.register_kernel(
+    "sharded_encode",
+    in_names=("stripe_bytes",),
+    out_names=("parity_bytes",),
+    build_local=_encode_local,
+    build_global=_encode_global,
+)
+rules.register_kernel(
+    "sharded_encode_seq",
+    in_names=("seq_bytes",),
+    out_names=("seq_parity",),
+    build_global=_encode_seq_global,
+)
+rules.register_kernel(
+    "mesh_encode_hash",
+    in_names=("stripe_words",),
+    out_names=("parity_words", "data_digests", "parity_digests"),
+    build_local=_encode_hash_local,
+    build_global=_encode_hash_global,
+    # the data-words buffer is a fresh device_put per batch; donating it
+    # lets XLA alias it into the parity output instead of copying
+    donate_argnums=(0,),
+)
+rules.register_kernel(
+    "mesh_reconstruct",
+    in_names=("survivor_words",),
+    out_names=("recon_words",),
+    build_local=_reconstruct_local,
+    build_global=_reconstruct_global,
+)
+rules.register_kernel(
+    "mesh_digest",
+    in_names=("digest_rows",),
+    out_names=("digest_out",),
+    build_global=_digest_global,
+)
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
 def sharded_encode(
     mesh: Mesh, data: jax.Array, parity_shards: int
 ) -> jax.Array:
@@ -117,41 +307,12 @@ def sharded_encode(
     replicated over "shard" (each shard-group device holds the full parity,
     like every disk holding its own shard after the fan-out write).
     """
-    batch, k, length = data.shape
-    m = parity_shards
+    _, k, _ = data.shape
     shard_n = mesh.shape["shard"]
     if k % shard_n:
         raise ValueError(f"k={k} not divisible by shard axis {shard_n}")
-    matrix = gf.parity_matrix(k, m)
-    k_local = k // shard_n
-
-    def step(local: jax.Array) -> jax.Array:
-        # local: (batch/stripe_n, k_local, length)
-        idx = jax.lax.axis_index("shard")
-        words = rs.bytes_to_words(local)
-
-        def one_stripe(w):
-            # select this device's columns of the generator matrix
-            cols = jnp.stack(
-                [
-                    jnp.asarray(matrix[:, s * k_local : (s + 1) * k_local])
-                    for s in range(shard_n)
-                ]
-            )  # (shard_n, m, k_local) - static stack, dynamic row pick
-            my_cols = cols[idx]
-            partial = rs._matmul_words_dynamic(w, my_cols)
-            return partial
-
-        partial = jax.vmap(one_stripe)(words)
-        total = xor_allreduce(partial, "shard")
-        return rs.words_to_bytes(total)
-
-    fn = _shard_map(
-        step,
-        mesh=mesh,
-        in_specs=P("stripe", "shard", None),
-        out_specs=P("stripe", None, None),
-        check_vma=False,
+    fn = rules.compile_kernel(
+        "sharded_encode", mesh, k=k, m=parity_shards
     )
     return fn(data)
 
@@ -164,26 +325,11 @@ def sharded_encode_seq(mesh: Mesh, data: jax.Array, parity_shards: int) -> jax.A
     this is the long-context scaling path (SURVEY.md section 5
     "long-context / sequence parallelism").
     """
-    k, length = data.shape
-    matrix = gf.parity_matrix(k, parity_shards)
-
-    def step(local: jax.Array) -> jax.Array:
-        words = rs.bytes_to_words(local)
-        return rs.words_to_bytes(rs._encode_words(words, matrix))
-
-    fn = _shard_map(
-        step,
-        mesh=mesh,
-        in_specs=P(None, ("stripe", "shard")),
-        out_specs=P(None, ("stripe", "shard")),
-        check_vma=False,
+    k, _ = data.shape
+    fn = rules.compile_kernel(
+        "sharded_encode_seq", mesh, k=k, m=parity_shards
     )
     return fn(data)
-
-
-def put_sharded(mesh: Mesh, x: np.ndarray, spec: P) -> jax.Array:
-    """Place a host array onto the mesh with the given partition spec."""
-    return jax.device_put(x, NamedSharding(mesh, spec))
 
 
 # ---------------------------------------------------------------------------
@@ -229,53 +375,6 @@ def _bucket_batch(batch: int, stripe: int) -> int:
     return stripe * p
 
 
-@functools.lru_cache(maxsize=64)
-def _encode_hash_fn(mesh: Mesh, k: int, m: int, shard_len: int):
-    """Build the jitted sharded encode+digest step for one geometry."""
-    from ..ops import codec_step, hash as phash
-
-    shard_n = mesh.shape["shard"]
-    k_local = k // shard_n
-    matrix = gf.parity_matrix(k, m)
-    col_blocks = np.stack(
-        [matrix[:, s * k_local : (s + 1) * k_local] for s in range(shard_n)]
-    )  # (shard_n, m, k_local)
-
-    def step(local: jax.Array):
-        # local: (B_local, k_local, w)
-        if shard_n == 1:
-            # stripe-only mesh (the large-batch default): whole stripes are
-            # device-local, so run the fused single-device kernel (static
-            # matrix -> Pallas on TPU) instead of the dynamic bit-walk.
-            parity, digests = codec_step.encode_and_hash_words(
-                local, m, shard_len
-            )
-            return parity, digests[:, :k], digests[:, k:]
-        idx = jax.lax.axis_index("shard")
-        my_cols = jnp.asarray(col_blocks)[idx]
-        partial = jax.vmap(
-            lambda wds: rs._matmul_words_dynamic(wds, my_cols)
-        )(local)
-        parity = xor_allreduce(partial, "shard")  # (B_local, m, w)
-        ddig = phash.phash256_words_batched(local, shard_len)
-        pdig = phash.phash256_words_batched(parity, shard_len)
-        return parity, ddig, pdig
-
-    return jax.jit(
-        _shard_map(
-            step,
-            mesh=mesh,
-            in_specs=P("stripe", "shard", None),
-            out_specs=(
-                P("stripe", None, None),
-                P("stripe", "shard", None),
-                P("stripe", None, None),
-            ),
-            check_vma=False,
-        )
-    )
-
-
 def mesh_encode_hash(
     mesh: Mesh, words: np.ndarray, parity_shards: int, shard_len: int
 ):
@@ -301,16 +400,17 @@ def mesh_encode_hash_begin(
     layer's double-buffered pipeline (encode_begin/encode_end) overlaps
     this batch's mesh pass with the previous batch's disk writes on the
     mesh path too, not just the single-device one.
+
+    The device copy of ``words`` is donated to the kernel (the host
+    array is untouched; only the fresh on-device buffer is recycled).
     """
-    B, k, w = words.shape
+    B, k, _ = words.shape
     stripe = mesh.shape["stripe"]
-    bpad = _bucket_batch(B, stripe)
-    if bpad != B:
-        words = np.concatenate(
-            [words, np.zeros((bpad - B, k, w), dtype=np.uint32)]
-        )
-    fn = _encode_hash_fn(mesh, k, parity_shards, shard_len)
-    dd = put_sharded(mesh, words, P("stripe", "shard", None))
+    words = _pad_batch(words, _bucket_batch(B, stripe))
+    fn = rules.compile_kernel(
+        "mesh_encode_hash", mesh, k=k, m=parity_shards, shard_len=shard_len
+    )
+    dd = put_sharded(mesh, words, rules.spec_for("stripe_words"))
     parity, ddig, pdig = fn(dd)
     return parity, ddig, pdig, B
 
@@ -322,41 +422,6 @@ def mesh_encode_hash_end(handle):
         [np.asarray(ddig)[:B], np.asarray(pdig)[:B]], axis=1
     )
     return np.asarray(parity)[:B], digests
-
-
-@functools.lru_cache(maxsize=64)
-def _reconstruct_fn(mesh: Mesh, k: int, m: int, idx: tuple[int, ...]):
-    """Jitted sharded reconstruct for one survivor pattern."""
-    shard_n = mesh.shape["shard"]
-    k_local = k // shard_n
-    rm = gf.reconstruction_matrix(k, m, idx)  # (k, k) survivors -> data
-    col_blocks = np.stack(
-        [rm[:, s * k_local : (s + 1) * k_local] for s in range(shard_n)]
-    )
-
-    def step(local: jax.Array):
-        # local: (B_local, k_local, w) compacted survivor rows
-        if shard_n == 1:
-            B_local, _, w = local.shape
-            flat = local.transpose(1, 0, 2).reshape(k, B_local * w)
-            dw = rs._matmul_static(flat, rm)
-            return dw.reshape(k, B_local, w).transpose(1, 0, 2)
-        dev = jax.lax.axis_index("shard")
-        my_cols = jnp.asarray(col_blocks)[dev]
-        partial = jax.vmap(
-            lambda wds: rs._matmul_words_dynamic(wds, my_cols)
-        )(local)
-        return xor_allreduce(partial, "shard")
-
-    return jax.jit(
-        _shard_map(
-            step,
-            mesh=mesh,
-            in_specs=P("stripe", "shard", None),
-            out_specs=P("stripe", None, None),
-            check_vma=False,
-        )
-    )
 
 
 def mesh_reconstruct(
@@ -376,34 +441,14 @@ def mesh_reconstruct(
     if len(idx) < k:
         raise ValueError(f"need {k} shards, have {len(idx)}")
     surv = np.ascontiguousarray(words[:, idx, :])  # (B, k, w)
-    B, _, w = surv.shape
+    B = surv.shape[0]
     stripe = mesh.shape["stripe"]
-    bpad = _bucket_batch(B, stripe)
-    if bpad != B:
-        surv = np.concatenate(
-            [surv, np.zeros((bpad - B, k, w), dtype=np.uint32)]
-        )
-    fn = _reconstruct_fn(mesh, k, m, idx)
-    dd = put_sharded(mesh, surv, P("stripe", "shard", None))
-    return np.asarray(fn(dd))[:B]
-
-
-@functools.lru_cache(maxsize=8)
-def _digest_fn(mesh: Mesh, shard_len: int):
-    from ..ops import hash as phash
-
-    def step(local: jax.Array):
-        return phash.phash256_words_batched(local, shard_len)
-
-    return jax.jit(
-        _shard_map(
-            step,
-            mesh=mesh,
-            in_specs=P(("stripe", "shard"), None),
-            out_specs=P(("stripe", "shard"), None),
-            check_vma=False,
-        )
+    surv = _pad_batch(surv, _bucket_batch(B, stripe))
+    fn = rules.compile_kernel(
+        "mesh_reconstruct", mesh, k=k, m=m, idx=idx
     )
+    dd = put_sharded(mesh, surv, rules.spec_for("survivor_words"))
+    return np.asarray(fn(dd))[:B]
 
 
 def mesh_digest(mesh: Mesh, words: np.ndarray, shard_len: int) -> np.ndarray:
@@ -412,13 +457,9 @@ def mesh_digest(mesh: Mesh, words: np.ndarray, shard_len: int) -> np.ndarray:
     Rows (any flattened batch of shards) are spread over every device on
     both axes - digesting is embarrassingly parallel.
     """
-    R, w = words.shape
+    R = words.shape[0]
     n_dev = mesh.devices.size
-    rpad = _bucket_batch(R, n_dev)
-    if rpad != R:
-        words = np.concatenate(
-            [words, np.zeros((rpad - R, w), dtype=np.uint32)]
-        )
-    fn = _digest_fn(mesh, shard_len)
-    dd = put_sharded(mesh, words, P(("stripe", "shard"), None))
+    words = _pad_batch(words, _bucket_batch(R, n_dev))
+    fn = rules.compile_kernel("mesh_digest", mesh, shard_len=shard_len)
+    dd = put_sharded(mesh, words, rules.spec_for("digest_rows"))
     return np.asarray(fn(dd))[:R]

@@ -415,6 +415,33 @@ class Metrics:
             "Hedged reads abandoned without contributing",
             [({}, hedge.get("wasted", 0))],
         )
+        placement = snap.get("placement", {})
+        emit(
+            "miniotpu_codec_placement_total", "counter",
+            "Merged-batch placement decisions (span = full mesh,"
+            " route = least-loaded submesh)",
+            [
+                ({"policy": outcome}, placement.get(outcome, 0))
+                for outcome in ("span", "route")
+            ],
+        )
+        submeshes = snap.get("submeshes", [])
+        emit(
+            "miniotpu_codec_submesh_queue_depth", "gauge",
+            "In-flight merged batches per codec submesh",
+            [
+                ({"submesh": s["submesh"]}, s["depth"])
+                for s in submeshes
+            ],
+        )
+        emit(
+            "miniotpu_codec_submesh_queue_depth_peak", "gauge",
+            "High-water mark of in-flight batches per codec submesh",
+            [
+                ({"submesh": s["submesh"]}, s["depth_hwm"])
+                for s in submeshes
+            ],
+        )
         stages = snap["stages"]
         emit(
             "miniotpu_codec_stage_seconds_total", "counter",

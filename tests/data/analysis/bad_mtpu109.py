@@ -1,0 +1,20 @@
+"""Fixture: hand-written PartitionSpec literals outside rules.py.
+
+Linted under rel_path minio_tpu/parallel/bad_mtpu109.py (the rule is
+scoped to minio_tpu/parallel/ + minio_tpu/ops/, exempting
+parallel/rules.py itself); the test asserts the exact (rule, line) set
+below.
+"""
+
+import jax.sharding as shd
+from jax.sharding import PartitionSpec as P
+
+
+def build_specs():
+    in_spec = P("stripe", "shard", None)  # VIOLATION: MTPU109
+    out_spec = shd.PartitionSpec("stripe", None, None)  # VIOLATION: MTPU109
+    return in_spec, out_spec
+
+
+def replicated():
+    return P()  # VIOLATION: MTPU109

@@ -1,0 +1,23 @@
+"""Fixture: shardings resolved through the partition-rule table.
+
+Linted under rel_path minio_tpu/parallel/good_mtpu109.py - in scope,
+but every spec comes from rules.spec_for (and annotations/imports that
+merely NAME PartitionSpec are not literals), so MTPU109 stays silent.
+"""
+
+from jax.sharding import PartitionSpec
+
+from minio_tpu.parallel import rules
+
+
+def build_specs():
+    return (
+        rules.spec_for("stripe_words"),
+        rules.spec_for("parity_words"),
+    )
+
+
+def annotated(spec: PartitionSpec) -> PartitionSpec:
+    # referencing the type (annotation, isinstance) is not a literal
+    assert isinstance(spec, PartitionSpec)
+    return spec

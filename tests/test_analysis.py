@@ -306,6 +306,66 @@ def test_mtpu108_fires_on_the_shipped_aio_module_if_seeded():
     assert any(f.rule == "MTPU108" for f in found)
 
 
+# -- MTPU109: PartitionSpec literals live only in parallel/rules.py -----
+#
+# Scope is path-keyed (minio_tpu/parallel/ + minio_tpu/ops/, with
+# parallel/rules.py itself exempt as the single source of truth), so
+# the fixtures get dedicated tests like MTPU107/108.
+
+
+def test_bad_mtpu109_exact_findings_under_parallel_scope():
+    expected = _expected_markers("bad_mtpu109.py")
+    assert expected, "bad_mtpu109.py declares no VIOLATION markers"
+    got = {
+        (f.rule, f.line)
+        for f in _lint_fixture(
+            "bad_mtpu109.py", rel_path="minio_tpu/parallel/bad_mtpu109.py"
+        )
+    }
+    assert got == expected
+
+
+def test_mtpu109_applies_under_ops_scope():
+    got = {
+        (f.rule, f.line)
+        for f in _lint_fixture(
+            "bad_mtpu109.py", rel_path="minio_tpu/ops/bad_mtpu109.py"
+        )
+    }
+    assert {
+        (r, ln)
+        for r, ln in _expected_markers("bad_mtpu109.py")
+        if r == "MTPU109"
+    } <= got
+
+
+def test_good_mtpu109_clean_under_parallel_scope():
+    found = _lint_fixture(
+        "good_mtpu109.py", rel_path="minio_tpu/parallel/good_mtpu109.py"
+    )
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_mtpu109_exempts_the_rule_table_itself():
+    """The same literals linted AS parallel/rules.py raise nothing —
+    the table is where the literals are supposed to live."""
+    found = _lint_fixture(
+        "bad_mtpu109.py", rel_path="minio_tpu/parallel/rules.py"
+    )
+    assert not any(f.rule == "MTPU109" for f in found), "\n".join(
+        f.render() for f in found
+    )
+
+
+def test_mtpu109_silent_outside_sharding_scope():
+    found = _lint_fixture(
+        "bad_mtpu109.py", rel_path="minio_tpu/server/bad_mtpu109.py"
+    )
+    assert not any(f.rule == "MTPU109" for f in found), "\n".join(
+        f.render() for f in found
+    )
+
+
 def test_noqa_suppresses_matching_rule():
     found = _lint_fixture("noqa_suppressed.py")
     assert found == [], "\n".join(f.render() for f in found)
