@@ -53,6 +53,10 @@ DEFAULT_TARGETS = (
     "minio_tpu.storage.health",
     "minio_tpu.storage.faults",
     "minio_tpu.parallel.iopool",
+    # cluster harness + chaos grid: lock-free today, audited so any
+    # future lock added to the multi-process driver enters the graph
+    "minio_tpu.cluster.harness",
+    "minio_tpu.testgrid.engine",
 )
 
 _THIS_FILE = os.path.abspath(__file__)

@@ -135,6 +135,35 @@ class Dsync:
         with self._mu:
             return uid in self._lost
 
+    def release_all(self) -> int:
+        """Release every held lock on every locker node (graceful
+        shutdown): a restarting node must unwind its grants instead of
+        leaving orphaned entries for peers to expire by timeout.
+        Returns the number of locks released."""
+        with self._mu:
+            held = list(self._held.values())
+            self._held.clear()
+            self._lost.clear()
+            self._refresh_fails.clear()
+        for args, read in held:
+            for c in self.lockers:
+                try:
+                    if read:
+                        c.runlock(args)
+                    else:
+                        c.unlock(args)
+                except Exception as exc:
+                    _log.debug(
+                        "shutdown release failed; entry ages out",
+                        extra=kv(uid=args.uid, err=str(exc)),
+                    )
+        if held:
+            _log.info(
+                "released held locks at shutdown",
+                extra=kv(count=len(held)),
+            )
+        return len(held)
+
     def close(self) -> None:
         self._stop.set()
         if self._threads is not None:

@@ -13,6 +13,7 @@ counts them against tolerance) and are swallowed on release/refresh
 from __future__ import annotations
 
 import http.client
+import random
 import threading
 import time
 import urllib.parse
@@ -31,6 +32,14 @@ PREFIX = "/minio-tpu/lock/v1"
 _TOKEN_TTL_S = 900
 
 _METHODS = ("lock", "unlock", "rlock", "runlock", "refresh", "forceunlock")
+
+
+def _never_sent(e: Exception) -> bool:
+    """True when the transport failure provably happened before the
+    request reached the peer, making a retry safe even for
+    non-idempotent grant methods.  ECONNREFUSED means the TCP connect
+    itself failed — no byte of the request was transmitted."""
+    return isinstance(e, ConnectionRefusedError)
 
 
 def _pack_args(args: LockArgs) -> bytes:
@@ -147,25 +156,34 @@ class LockRESTClient(NetLocker):
             "Content-Length": str(len(body)),
         }
         url = f"{PREFIX}/{method}"
-        # lock/rlock are NOT retried: a lost response may mean the grant
-        # was applied server-side, and re-sending the same uid would turn
-        # it into an unowned phantom grant.  The caller cleans up with a
-        # best-effort release instead (DRWMutex.ask).  Releases and
-        # refreshes are idempotent and retry once on a fresh connection.
-        attempts = (0,) if method in ("lock", "rlock") else (0, 1)
-        for attempt in attempts:
+        # lock/rlock are normally NOT retried: a lost response may mean
+        # the grant was applied server-side, and re-sending the same uid
+        # would turn it into an unowned phantom grant.  The one safe
+        # exception is a refused/never-established connection (a peer
+        # mid-restart rebinding its listener): nothing reached the
+        # server, so one retry after a jittered backoff converts the
+        # restart window into latency instead of a transient quorum
+        # error.  Releases and refreshes are idempotent and retry once
+        # on any transport failure.
+        idempotent = method not in ("lock", "rlock")
+        for attempt in (0, 1):
             conn = self._conn()
             try:
                 conn.request("POST", url, body=body, headers=headers)
                 resp = conn.getresponse()
                 payload = resp.read()
                 break
-            except (OSError, http.client.HTTPException):
+            except (OSError, http.client.HTTPException) as e:
                 self._drop_conn()
-                if attempt == attempts[-1]:
+                if attempt or not (
+                    idempotent or _never_sent(e)
+                ):
                     raise ConnectionError(
                         f"lock plane {self.host}:{self.port} unreachable"
                     ) from None
+                # jittered backoff: give a restarting peer a beat to
+                # finish rebinding before the single retry
+                time.sleep(0.02 + random.random() * 0.08)
         if resp.status != 200:
             raise ConnectionError(
                 f"lock plane {self.host}:{self.port}: "

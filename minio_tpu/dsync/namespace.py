@@ -140,9 +140,30 @@ class DistNamespaceLock:
         self._ds = ds
         self._source = source
         # self-tuning lock-wait budgets (the reference wraps its object
-        # locks in newDynamicTimeout(30s, 1s))
+        # locks in newDynamicTimeout(30s, 1s)); the write budget is
+        # overridable so a write that can never reach lock quorum 503s
+        # on an operator-chosen clock instead of 30s. Reads keep the
+        # full default: a read below quorum fails fast anyway, and a
+        # shorter seed decays to the 1s floor quickly enough to shed
+        # healthy reads under hot-key load.
+        import os
+
+        wbudget = max(
+            1.0,
+            float(
+                os.environ.get("MINIO_TPU_WRITE_LOCK_ACQUIRE_S") or 30.0
+            ),
+        )
         self._rtimeout = DynamicTimeout(30.0, 1.0)
-        self._wtimeout = DynamicTimeout(30.0, 1.0)
+        self._wtimeout = DynamicTimeout(wbudget, 1.0)
+
+    def release_all(self) -> int:
+        """Graceful-shutdown unwind: release every lock this process
+        still holds on the cluster, then stop the refresher threads.
+        Stragglers a peer could not be told about age out via expiry."""
+        released = self._ds.release_all()
+        self._ds.close()
+        return released
 
     @contextlib.contextmanager
     def read(self, volume: str, path: str, timeout: "float | None" = None):

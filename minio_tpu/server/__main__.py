@@ -321,6 +321,14 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    # sigwait only *claims* a signal that is blocked; an unblocked
+    # SIGTERM races the default disposition (immediate termination) and
+    # usually loses, skipping the graceful drain below.  Block both
+    # before any thread spawns so every thread inherits the mask.
+    signal.pthread_sigmask(
+        signal.SIG_BLOCK, {signal.SIGINT, signal.SIGTERM}
+    )
+
     from ..utils import log
 
     log.setup(os.environ.get("MINIO_TPU_LOG_LEVEL", "info"))
@@ -342,12 +350,31 @@ def main(argv=None) -> int:
     # Discover local drives first so the storage plane can serve peers
     # BEFORE format bootstrap (reference starts HTTP at
     # server-main.go:477, then waits for disks).
+    # With MINIO_TPU_FAULT_INJECTION=1 each local drive is wrapped in a
+    # FaultDisk at the bottom of the wrap chain
+    # (DiskIDCheck(Metered(Fault(XL)))), and the admin fault endpoint
+    # can schedule delay/error/corrupt/hang rules on it remotely - the
+    # chaos-grid harness degrades nodes it does not share memory with.
+    fault_on = (os.environ.get("MINIO_TPU_FAULT_INJECTION") or "") in (
+        "1",
+        "on",
+        "true",
+    )
+    fault_seed = int(os.environ.get("MINIO_TPU_FAULT_SEED") or 0)
+    fault_disks: dict = {}
     pre_local: list = []
     local_map: dict = {}
     for specs in group_zone_args(args.zones):
         for ep in resolve_endpoints(specs, local_port):
             if ep.is_local:
                 d = XLStorage(ep.path, endpoint=ep.raw)
+                if fault_on:
+                    from ..storage.faults import FaultDisk
+
+                    d = FaultDisk(
+                        d, seed=fault_seed + len(fault_disks)
+                    )
+                    fault_disks[str(d.unwrapped.root)] = d
                 pre_local.append(d)
                 local_map[ep.path] = d
 
@@ -359,6 +386,11 @@ def main(argv=None) -> int:
         region=args.region,
         internode_secret=args.secret_key,
     )
+    if fault_disks:
+        srv.fault_disks = fault_disks
+    # readiness gate: /minio/health/ready stays 503 until every
+    # subsystem flips its flag, so a harness polls instead of sleeping
+    srv.boot_status = {"lock_plane": False, "boot": False}
     storage_rest = StorageRESTServer(pre_local, args.secret_key)
     srv.register_internode(STORAGE_PREFIX, storage_rest.handle)
     nslock, lock_rest, _lock_maint = build_lock_plane(
@@ -368,6 +400,7 @@ def main(argv=None) -> int:
         from ..dsync.lock_rest import PREFIX as LOCK_PREFIX
 
         srv.register_internode(LOCK_PREFIX, lock_rest.handle)
+    srv.boot_status["lock_plane"] = True
 
     # peer control plane + bootstrap handshake (distributed mode):
     # every node serves /minio-tpu/peer/v1 and verifies the cluster
@@ -554,6 +587,7 @@ def main(argv=None) -> int:
     else:
         desc = "standalone FS backend (1 drive)"
         zcount = 0
+    srv.boot_status["boot"] = True
     print(f"minio-tpu serving {desc} at {srv.endpoint}")
     sys.stdout.flush()
     log.logger("server").info(
@@ -562,8 +596,21 @@ def main(argv=None) -> int:
     )
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}, shutting down")
+    # graceful teardown order: drain in-flight requests first (their
+    # handlers release their own locks), stop heal/crawler/monitor
+    # threads (inside srv.shutdown), THEN unwind whatever dsync grants
+    # remain so peers see clean releases instead of waiting out the
+    # expiry window on orphaned entries.
     tracker.save()  # flush marks recorded since the last rotation
     srv.shutdown()
+    if _lock_maint is not None:
+        _lock_maint.stop()
+    if hasattr(nslock, "release_all"):
+        released = nslock.release_all()
+        if released:
+            print(f"released {released} held lock(s)")
+    print("shutdown complete")
+    sys.stdout.flush()
     return 0
 
 

@@ -231,6 +231,13 @@ class AdminAPI:
                 raise S3Error("ServerNotInitialized")
             # an explicit admin crawl bypasses the freshness gate
             return 200, _json(crawler.crawl_once(force=True).to_dict())
+        # chaos fault control (cluster harness): schedule FaultDisk
+        # rules on THIS node's local drives over the wire, so a test
+        # driver can degrade a REMOTE process it does not share memory
+        # with.  Only mounted when the server was started with
+        # MINIO_TPU_FAULT_INJECTION=1 (fault_disks is absent otherwise).
+        if tail in ("fault/inject", "fault/clear", "fault/status"):
+            return self._fault(method, tail, body)
         # bucket quota (admin SetBucketQuota / GetBucketQuotaConfig)
         if route == ("GET", "get-bucket-quota"):
             ol.get_bucket_info(_req(q, "bucket"))
@@ -392,6 +399,74 @@ class AdminAPI:
         raise S3Error("MethodNotAllowed", f"admin {method} /{tail}")
 
     # -- handlers ---------------------------------------------------------
+
+    def _fault(
+        self, method: str, tail: str, body: bytes
+    ) -> "tuple[int, bytes]":
+        """Remote fault control for the cluster harness.
+
+        POST fault/inject  {disk, api, delay_s, hang_s, error, corrupt,
+                            prob, calls} - add one schedule rule; "disk"
+                            matches a local drive root by suffix ("*"
+                            or absent = every local drive).
+        POST fault/clear   {disk} - lift rules + release parked hangs.
+        GET  fault/status  per-drive rule count + injected-action tally.
+        """
+        fault_disks = getattr(self.s3, "fault_disks", None)
+        if not fault_disks:
+            raise S3Error(
+                "InvalidArgument",
+                "fault injection disabled: start the server with "
+                "MINIO_TPU_FAULT_INJECTION=1",
+            )
+        if (method, tail) == ("GET", "fault/status"):
+            return 200, _json(
+                {
+                    root: {
+                        "rules": fd.rule_count(),
+                        "injected": fd.injected(),
+                    }
+                    for root, fd in sorted(fault_disks.items())
+                }
+            )
+        doc = _body_json(body) if body.strip() else {}
+        sel = str(doc.get("disk", "*"))
+        matched = {
+            root: fd
+            for root, fd in fault_disks.items()
+            if sel in ("", "*") or root.endswith(sel)
+        }
+        if not matched:
+            raise S3Error(
+                "InvalidArgument", f"no local drive matches {sel!r}"
+            )
+        if (method, tail) == ("POST", "fault/clear"):
+            for fd in matched.values():
+                fd.clear()
+            return 200, _json({"cleared": sorted(matched)})
+        if (method, tail) != ("POST", "fault/inject"):
+            raise S3Error("MethodNotAllowed", f"admin {method} /{tail}")
+        api = doc.get("api")
+        if not api:
+            raise S3Error("InvalidArgument", "missing api")
+        calls = doc.get("calls")
+        if calls is not None and not isinstance(calls, list):
+            raise S3Error("InvalidArgument", "calls must be a list")
+        for fd in matched.values():
+            fd.inject(
+                str(api),
+                delay_s=float(doc.get("delay_s", 0.0)),
+                hang_s=float(doc.get("hang_s", 0.0)),
+                error=bool(doc.get("error", False)),
+                corrupt=bool(doc.get("corrupt", False)),
+                prob=float(doc.get("prob", 1.0)),
+                calls=calls,
+            )
+        _log.info(
+            "fault schedule injected",
+            extra=kv(api=str(api), disks=len(matched)),
+        )
+        return 200, _json({"injected": sorted(matched)})
 
     def _health_info_local(self, ol) -> dict:
         """This node's OBD document: platform + memory + per-local-

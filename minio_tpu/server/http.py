@@ -172,6 +172,13 @@ class S3Server:
         )
         self.heal_routine = None  # attached by the server main
         self.heal_queue = None
+        # readiness gate (healthcheck ready-parity): the server main
+        # populates this dict as subsystems come up, so the ready
+        # endpoint reports object-layer + lock-plane init complete and
+        # a cluster harness can poll instead of sleeping.  None (the
+        # embedded/test default) keeps the legacy semantics: ready as
+        # soon as an object layer is attached.
+        self.boot_status: "dict[str, bool] | None" = None
         # federation bucket DNS (cluster/dns.BucketDNS); None when
         # this deployment is not federated
         self.bucket_dns = None
@@ -396,6 +403,20 @@ class S3Server:
         if self._thread:
             self._thread.join(timeout=5)
         self.events.shutdown()
+        # background maintenance threads (heal routine, fresh-disk
+        # monitor, crawler) stop AFTER the drain so an in-flight PUT's
+        # heal hooks land, but before lock unwinding so they cannot
+        # take new namespace locks during teardown
+        for attr in ("crawler", "disk_monitor", "heal_routine"):
+            worker = getattr(self, attr, None)
+            if worker is not None and hasattr(worker, "stop"):
+                try:
+                    worker.stop()
+                except Exception as exc:
+                    _log.debug(
+                        "background worker stop failed",
+                        extra=kv(worker=attr, err=str(exc)),
+                    )
         # replication workers are per-server threads, not process
         # singletons: leaving them running after shutdown is a leak
         # (caught by the tests' leakcheck fixture)
@@ -415,6 +436,18 @@ class S3Server:
         # process constructing several servers (tests, embedders) must
         # not accumulate one live handler per dead server
         self.console.uninstall()
+
+    def readiness(self) -> "tuple[bool, bytes]":
+        """(ready, JSON body) for /minio/health/ready: object layer
+        attached, every boot_status subsystem up, and not draining."""
+        import json as _json
+
+        doc = {"object_layer": self.object_layer is not None}
+        if self.boot_status is not None:
+            doc.update(self.boot_status)
+        ok = all(doc.values()) and not self.draining
+        doc["draining"] = self.draining
+        return ok, _json.dumps(doc, sort_keys=True).encode()
 
     @property
     def endpoint(self) -> str:
@@ -680,9 +713,12 @@ class _Handler(BaseHTTPRequestHandler):
             return self._respond(200, content_type="text/plain")
         if path in ("/minio/health/ready", "/minio/health/cluster"):
             self._finish_body()
-            if self.s3.object_layer is None:
-                return self._respond(503, content_type="text/plain")
-            return self._respond(200, content_type="text/plain")
+            ready, doc = self.s3.readiness()
+            return self._respond(
+                200 if ready else 503,
+                doc,
+                content_type="application/json",
+            )
         if path == "/minio-tpu/prometheus/metrics":
             self._finish_body()
             if not self.s3.metrics_public:

@@ -112,6 +112,11 @@ class FaultDisk:
         with self._mu:
             return dict(self._injected)
 
+    def rule_count(self) -> int:
+        """Number of active schedule rules (admin fault/status)."""
+        with self._mu:
+            return len(self._rules)
+
     # -- schedule execution -----------------------------------------------
 
     def _plan(self, api: str) -> "dict | None":

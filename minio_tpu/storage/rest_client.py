@@ -15,6 +15,7 @@ backoff, mirroring the lazy reconnect of storage-rest-client.go:677.
 from __future__ import annotations
 
 import http.client
+import random
 import threading
 import time
 import urllib.parse
@@ -284,7 +285,7 @@ class StorageRESTClient(StorageAPI):
                     raise DiskNotFound(
                         f"{self._endpoint} timed out"
                     ) from None
-            except (OSError, http.client.HTTPException):
+            except (OSError, http.client.HTTPException) as e:
                 # one retry on a fresh connection (stale keep-alive)
                 self._drop_conn()
                 if attempt:
@@ -293,6 +294,19 @@ class StorageRESTClient(StorageAPI):
                     raise DiskNotFound(
                         f"{self._endpoint} unreachable"
                     ) from None
+                if isinstance(
+                    e,
+                    (
+                        ConnectionRefusedError,
+                        ConnectionResetError,
+                        BrokenPipeError,
+                    ),
+                ):
+                    # refused/reset is the peer-restart signature: a
+                    # jittered backoff before the single retry bridges
+                    # the listener-rebind window instead of surfacing a
+                    # transient DiskNotFound to the quorum path
+                    time.sleep(0.05 + random.random() * 0.15)
         dyn.log_success(time.monotonic() - t0)
         self._online = True
         if resp.status == 200:

@@ -1,0 +1,472 @@
+"""Scenario interpreter: steps -> harness actions -> invariant checks.
+
+A scenario is data, not code: a tuple of small step verbs executed in
+order against a live ClusterHarness, followed by the shared invariant
+sweep.  The verbs deliberately mirror what an operator can do to a real
+pool (degrade a drive, kill a process, restart it, keep client load
+running) - nothing reaches into a node's memory; every interaction
+crosses the wire.
+
+Quorum invariants checked after every scenario:
+
+- **readable-at-quorum**: every tracked object GETs bit-identical bytes
+  from EVERY live node, and the bytes match one of the payloads a
+  client successfully wrote (or plausibly wrote: a failed overwrite may
+  have landed before the error) - or every node agrees it is cleanly
+  absent.  Split answers between nodes are a violation.
+- **no-torn-meta**: every xl.meta on every drive of every node still
+  decodes (XLMeta.from_bytes); a torn or half-written journal fails.
+- **breaker-cycle** (opt-in per scenario via await_breaker steps): the
+  observer node's circuit breaker for the faulted node's drives must
+  reach TRIPPED while the fault holds and return to HEALTHY after it
+  lifts (half-open probe recovery).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+
+from ..cluster.harness import ClusterHarness
+from ..utils.log import kv, logger
+
+_log = logger("testgrid")
+
+BUCKET = "grid"
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One remote FaultDisk rule, addressed to a node."""
+
+    node: int
+    api: str
+    disk: str = "*"
+    delay_s: float = 0.0
+    hang_s: float = 0.0
+    error: bool = False
+    corrupt: bool = False
+    prob: float = 1.0
+    calls: "tuple | None" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One grid cell: cluster shape + seeded data + step script."""
+
+    name: str
+    title: str
+    nodes: int = 3
+    drives_per_node: int = 2
+    seed_objects: int = 4
+    object_size: int = 48_000
+    steps: tuple = ()
+    # invariant toggles (the sweep itself is shared)
+    check_meta: bool = True
+    check_reads: bool = True
+
+
+def payload(n: int, seed: int) -> bytes:
+    return random.Random(seed).randbytes(n)
+
+
+class _Ctx:
+    """Mutable scenario state: which payloads a key may legally hold."""
+
+    def __init__(self, harness: ClusterHarness):
+        self.h = harness
+        # key -> list of acceptable payloads (last confirmed write
+        # first; failed overwrites appended - a 5xx PUT may still have
+        # reached quorum before the client saw the error)
+        self.objects: "dict[str, list[bytes]]" = {}
+        self.threads: "list[threading.Thread]" = []
+        self.errors: "list[str]" = []
+        self.breaker_log: "list[str]" = []
+
+    def confirm(self, key: str, body: bytes) -> None:
+        self.objects[key] = [body]
+
+    def attempt(self, key: str, body: bytes) -> None:
+        self.objects.setdefault(key, []).append(body)
+
+
+def _put(ctx: _Ctx, node: int, key: str, body: bytes) -> int:
+    status, _, _ = ctx.h.client(node).request(
+        "PUT", f"/{BUCKET}/{key}", body=body
+    )
+    if status == 200:
+        ctx.confirm(key, body)
+    else:
+        ctx.attempt(key, body)
+    return status
+
+
+def _get(ctx: _Ctx, node: int, key: str):
+    return ctx.h.client(node).request("GET", f"/{BUCKET}/{key}")
+
+
+# -- step verbs ------------------------------------------------------------
+
+
+def _step_fault(ctx: _Ctx, f: Fault) -> None:
+    ctx.h.inject_fault(
+        f.node,
+        f.api,
+        disk=f.disk,
+        delay_s=f.delay_s,
+        hang_s=f.hang_s,
+        error=f.error,
+        corrupt=f.corrupt,
+        prob=f.prob,
+        calls=None if f.calls is None else list(f.calls),
+    )
+
+
+def _step_clear(ctx: _Ctx, node: int) -> None:
+    ctx.h.clear_faults(node)
+
+
+def _step_put(ctx: _Ctx, node: int, key: str, size: int, seed: int) -> None:
+    status = _put(ctx, node, key, payload(size, seed))
+    if status not in (200, 503):
+        raise AssertionError(f"PUT {key} via n{node + 1}: HTTP {status}")
+
+
+def _step_churn(
+    ctx: _Ctx, node: int, keys: int, rounds: int, size: int, seed: int
+) -> None:
+    """Background writer: overwrite a keyset round-robin until joined.
+    Failures are tolerated (that is the point of churn under faults)
+    but recorded as attempts so the final sweep accepts either body."""
+
+    def run() -> None:
+        s = seed
+        for r in range(rounds):
+            for k in range(keys):
+                s += 1
+                try:
+                    _put(ctx, node, f"churn{k}", payload(size, s))
+                except OSError:
+                    # node restarting mid-request: retry next round
+                    time.sleep(0.2)
+
+    t = threading.Thread(target=run, name="grid-churn", daemon=True)
+    t.start()
+    ctx.threads.append(t)
+
+
+def _step_join(ctx: _Ctx, timeout_s: float = 120.0) -> None:
+    for t in ctx.threads:
+        t.join(timeout=timeout_s)
+        if t.is_alive():
+            raise AssertionError(f"workload thread {t.name} hung")
+    ctx.threads.clear()
+
+
+def _step_get_flood(
+    ctx: _Ctx, key: str, count: int, threads: int = 4
+) -> None:
+    """Hot-key read storm from every node; every reply must be 200 and
+    bit-identical to an acceptable payload."""
+    ok_bodies = ctx.objects[key]
+    fails: list[str] = []
+
+    import http.client as _hc
+
+    def run(worker: int) -> None:
+        for j in range(count):
+            node = (worker + j) % len(ctx.h.nodes)
+            if not ctx.h.nodes[node].alive():
+                continue
+            # a dropped connection under fault load is a transport
+            # hiccup, not a correctness violation: one retry on a
+            # fresh connection; only a persistent failure counts
+            for attempt in (0, 1):
+                try:
+                    status, _, body = _get(ctx, node, key)
+                except (OSError, _hc.HTTPException):
+                    if attempt:
+                        fails.append(f"n{node + 1}#{j}: transport")
+                    continue
+                if status != 200 or body not in ok_bodies:
+                    fails.append(f"n{node + 1}#{j}: HTTP {status}")
+                break
+
+    ts = [
+        threading.Thread(target=run, args=(w,), daemon=True)
+        for w in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    if fails:
+        raise AssertionError(
+            f"get flood on {key}: {len(fails)} bad reads "
+            f"(first: {fails[0]})"
+        )
+
+
+def _step_kill(ctx: _Ctx, node: int) -> None:
+    ctx.h.kill(node)
+
+
+def _step_terminate(ctx: _Ctx, node: int) -> None:
+    rc = ctx.h.terminate(node)
+    if rc != 0:
+        raise AssertionError(
+            f"n{node + 1} SIGTERM exit rc={rc}:\n"
+            + ctx.h.nodes[node].log_tail()
+        )
+
+
+def _step_restart(ctx: _Ctx, node: int, graceful: bool = False) -> None:
+    ctx.h.restart(node, graceful=graceful)
+
+
+def _step_wipe_drive(ctx: _Ctx, node: int, drive: int) -> None:
+    """Empty one drive dir while its node is down (drive swap)."""
+    import shutil
+
+    root = ctx.h.nodes[node].drive_dirs[drive]
+    for entry in list(root.iterdir()):
+        shutil.rmtree(entry, ignore_errors=True)
+
+
+def _step_sleep(ctx: _Ctx, seconds: float) -> None:
+    time.sleep(seconds)
+
+
+def _step_await_breaker(
+    ctx: _Ctx,
+    observer: int,
+    target: int,
+    state: int,
+    timeout_s: float = 60.0,
+) -> None:
+    """Poll the observer's miniotpu_disk_state for the target node's
+    drives until one reaches ``state`` (2=TRIPPED) or, for state 0,
+    until ALL are healthy again.  Reads are issued each poll so the
+    breaker sees traffic (half-open needs a probe request)."""
+    port_tag = f":{ctx.h.nodes[target].port}"
+    probe_keys = list(ctx.objects) or [""]
+    deadline = time.monotonic() + timeout_s
+    last: dict = {}
+    i = 0
+    while time.monotonic() < deadline:
+        if probe_keys[0]:
+            try:
+                _get(ctx, observer, probe_keys[i % len(probe_keys)])
+            except OSError:
+                pass
+            i += 1
+        states = {
+            ep: st
+            for ep, st in ctx.h.disk_states(observer).items()
+            if port_tag in ep
+        }
+        last = states
+        if states:
+            if state == 0 and all(st == 0 for st in states.values()):
+                ctx.breaker_log.append(f"n{target + 1}:recovered")
+                return
+            if state > 0 and any(
+                st >= state for st in states.values()
+            ):
+                ctx.breaker_log.append(f"n{target + 1}:state{state}")
+                return
+        time.sleep(0.25)
+    raise AssertionError(
+        f"breaker on n{observer + 1} never reached state {state} for "
+        f"n{target + 1} drives; last={last}"
+    )
+
+
+def _step_await_heal(
+    ctx: _Ctx,
+    node: int,
+    drive: int,
+    want_objects: "tuple",
+    timeout_s: float = 90.0,
+) -> None:
+    """Wait until every named object has a shard back on the wiped
+    drive - convergence with NO manual heal call (fresh-disk monitor
+    plus heal routine)."""
+    root = ctx.h.nodes[node].drive_dirs[drive]
+    want = set(want_objects)
+    deadline = time.monotonic() + timeout_s
+    healed: set = set()
+    while time.monotonic() < deadline:
+        healed = {
+            p.parent.parent.name
+            for p in root.glob(f"{BUCKET}/*/*/part.1")
+        }
+        if want <= healed:
+            return
+        time.sleep(0.5)
+    raise AssertionError(
+        f"heal never converged on n{node + 1} drive{drive + 1}: "
+        f"healed={sorted(healed)} want={sorted(want)}"
+    )
+
+
+def _step_await_locks_drained(
+    ctx: _Ctx, node: int, timeout_s: float = 30.0
+) -> None:
+    """top-locks on a node must drain to empty: a graceful peer
+    restart may not leave orphaned dsync entries behind."""
+    deadline = time.monotonic() + timeout_s
+    doc: dict = {}
+    while time.monotonic() < deadline:
+        status, doc = ctx.h.admin(node, "GET", "top-locks")
+        locks = doc.get("locks", doc if isinstance(doc, list) else [])
+        if status == 200 and not locks:
+            return
+        time.sleep(0.5)
+    raise AssertionError(
+        f"n{node + 1} still holds lock entries: {doc}"
+    )
+
+
+def _step_expect_put(
+    ctx: _Ctx, node: int, key: str, size: int, seed: int, status: int
+) -> None:
+    got = _put(ctx, node, key, payload(size, seed))
+    if got != status:
+        raise AssertionError(
+            f"PUT {key} via n{node + 1}: wanted HTTP {status}, "
+            f"got {got}"
+        )
+
+
+_VERBS = {
+    "fault": _step_fault,
+    "clear": _step_clear,
+    "put": _step_put,
+    "expect_put": _step_expect_put,
+    "churn": _step_churn,
+    "join": _step_join,
+    "get_flood": _step_get_flood,
+    "kill": _step_kill,
+    "terminate": _step_terminate,
+    "restart": _step_restart,
+    "wipe_drive": _step_wipe_drive,
+    "sleep": _step_sleep,
+    "await_breaker": _step_await_breaker,
+    "await_heal": _step_await_heal,
+    "await_locks_drained": _step_await_locks_drained,
+}
+
+
+# -- invariant sweep -------------------------------------------------------
+
+
+def check_quorum_reads(ctx: _Ctx) -> int:
+    """Every tracked key: all live nodes agree on one acceptable
+    payload, or all agree it is absent.  Returns keys verified."""
+    live = [n.index for n in ctx.h.nodes if n.alive()]
+    if not live:
+        raise AssertionError("no live nodes to verify reads against")
+    for key, bodies in sorted(ctx.objects.items()):
+        answers: "dict[int, tuple]" = {}
+        for node in live:
+            status, _, body = _get(ctx, node, key)
+            answers[node] = (status, body)
+        statuses = {s for s, _ in answers.values()}
+        if statuses == {404}:
+            continue  # cleanly absent everywhere
+        if statuses != {200}:
+            raise AssertionError(
+                f"{key}: split availability across nodes: "
+                f"{ {f'n{n + 1}': s for n, (s, _) in answers.items()} }"
+            )
+        distinct = {body for _, body in answers.values()}
+        if len(distinct) != 1:
+            raise AssertionError(
+                f"{key}: nodes disagree on content "
+                f"({len(distinct)} distinct payloads)"
+            )
+        got = next(iter(distinct))
+        if got not in bodies:
+            raise AssertionError(
+                f"{key}: stored payload matches NO client write "
+                f"({len(got)} bytes, {len(bodies)} candidates)"
+            )
+    return len(ctx.objects)
+
+
+def check_no_torn_meta(ctx: _Ctx) -> int:
+    """Every xl.meta on every drive must decode; torn journals fail.
+    Returns files checked."""
+    from ..storage.meta import XLMeta
+
+    checked = 0
+    for n in ctx.h.nodes:
+        for root in n.drive_dirs:
+            for p in root.rglob("xl.meta"):
+                raw = p.read_bytes()
+                try:
+                    XLMeta.from_bytes(raw)
+                except Exception as e:
+                    raise AssertionError(
+                        f"torn xl.meta on n{n.index + 1} at "
+                        f"{p.relative_to(root)}: {e}"
+                    ) from None
+                checked += 1
+    return checked
+
+
+def run_scenario(
+    sc: Scenario, base_dir, env: "dict | None" = None
+) -> dict:
+    """Execute one grid cell; returns a small report for assertions
+    and logging.  Raises AssertionError on any invariant violation."""
+    h = ClusterHarness(
+        base_dir,
+        nodes=sc.nodes,
+        drives_per_node=sc.drives_per_node,
+        env=env,
+    )
+    with h:
+        ctx = _Ctx(h)
+        status, _, _ = h.client(0).request("PUT", f"/{BUCKET}")
+        if status != 200:
+            raise AssertionError(f"make_bucket: HTTP {status}")
+        for i in range(sc.seed_objects):
+            body = payload(sc.object_size, 7_000 + i)
+            st = _put(ctx, i % sc.nodes, f"seed{i}", body)
+            if st != 200:
+                raise AssertionError(f"seed{i}: HTTP {st}")
+        for step in sc.steps:
+            verb, args = step[0], step[1:]
+            _log.debug(
+                "step", extra=kv(scenario=sc.name, verb=verb)
+            )
+            _VERBS[verb](ctx, *args)
+        # safety net: no scenario may leak schedules into the sweep
+        for n in h.nodes:
+            if n.alive():
+                try:
+                    h.clear_faults(n.index)
+                except RuntimeError as exc:
+                    _log.debug(
+                        "final fault clear failed",
+                        extra=kv(node=n.index, err=str(exc)),
+                    )
+        report = {
+            "scenario": sc.name,
+            "objects": 0,
+            "meta_files": 0,
+            "breaker_events": list(ctx.breaker_log),
+        }
+        if ctx.errors:
+            raise AssertionError(
+                f"{sc.name}: workload errors: {ctx.errors[:3]}"
+            )
+        if sc.check_reads:
+            report["objects"] = check_quorum_reads(ctx)
+        if sc.check_meta:
+            report["meta_files"] = check_no_torn_meta(ctx)
+    return report

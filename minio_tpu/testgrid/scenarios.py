@@ -1,0 +1,132 @@
+"""The chaos grid: one Scenario per failure archetype.
+
+Every cell runs on a 3-node x 2-drive loopback cluster (6-disk erasure
+set, data=3 / parity=3, write quorum 4) so ONE fully-degraded node
+still leaves both read and write quorum intact - scenarios can assert
+availability under faults, not just clean failure.
+
+Fault delivery is always remote: the driver process schedules
+FaultDisk rules inside another OS process via the authenticated admin
+fault endpoint, exactly how the harness would degrade a node it cannot
+reach into.
+"""
+
+from __future__ import annotations
+
+from .engine import Fault, Scenario
+
+SEEDS = ("seed0", "seed1", "seed2", "seed3")
+
+
+# A remote node's drives serve errors on every storage op: reads must
+# degrade (5 live disks >= data quorum), writes must still commit
+# (4 healthy drives = write quorum), the OBSERVER's breaker for the
+# faulted node must trip, and lifting the fault must recover it through
+# the half-open probe.
+DEAD_REMOTE_DISKS = Scenario(
+    name="dead_remote_disks",
+    title="dead remote disks: degraded IO + breaker trip/recover",
+    steps=(
+        ("fault", Fault(node=1, api="*", error=True)),
+        ("await_breaker", 0, 1, 2),
+        ("put", 0, "during-fault", 30_000, 101),
+        ("get_flood", "seed0", 5, 2),
+        ("clear", 1),
+        ("await_breaker", 0, 1, 0),
+        ("put", 0, "after-clear", 30_000, 102),
+    ),
+)
+
+# One node answers shard reads slowly; a hot-key read storm across all
+# nodes must stay bit-identical (hedged reads may race the slow disk,
+# but correctness never depends on who wins).
+SLOW_REMOTE_DISKS = Scenario(
+    name="slow_remote_disks",
+    title="slow remote disks: hot reads stay correct under hedging",
+    steps=(
+        ("fault", Fault(node=1, api="read_at", delay_s=0.2)),
+        ("get_flood", "seed1", 8, 4),
+        ("clear", 1),
+    ),
+)
+
+# Shard writes and the metadata-commit rename hang on one node while a
+# client PUTs: the write either commits at quorum or fails cleanly -
+# the sweep proves no torn xl.meta and no split availability.
+PARTITION_MID_PUT = Scenario(
+    name="partition_mid_put",
+    title="network partition mid-PUT: commit-or-clean, never torn",
+    steps=(
+        ("fault", Fault(node=1, api="write", hang_s=2.0)),
+        ("fault", Fault(node=1, api="rename_file", hang_s=2.0)),
+        ("put", 0, "torn-candidate", 60_000, 201),
+        ("put", 2, "torn-candidate", 60_000, 202),
+        ("clear", 1),
+        ("sleep", 0.5),
+    ),
+)
+
+# Rolling graceful restarts under live write load: every node cycles
+# while a writer churns; SIGTERM must drain + unwind dsync grants, so
+# after the roll no node holds orphaned lock entries and churned keys
+# read back consistent.
+ROLLING_RESTART = Scenario(
+    name="rolling_restart",
+    title="rolling restarts under load: drains, lock unwind, no orphans",
+    steps=(
+        ("churn", 0, 3, 30, 20_000, 300),
+        ("sleep", 0.5),
+        ("restart", 1, True),
+        ("restart", 2, True),
+        ("join",),
+        ("await_locks_drained", 0),
+        ("await_locks_drained", 1),
+        ("await_locks_drained", 2),
+    ),
+)
+
+# Heal storm racing live writes: a node dies, loses a drive's contents
+# (swap), and rejoins while a writer keeps churning - the fresh-disk
+# monitor + heal routine must reconstruct every seed shard with no
+# manual heal call, without corrupting the racing writes.
+HEAL_STORM = Scenario(
+    name="heal_storm",
+    title="heal storm vs live writes: wiped drive reconverges",
+    steps=(
+        ("kill", 2),
+        ("wipe_drive", 2, 0),
+        ("churn", 0, 2, 10, 20_000, 400),
+        ("restart", 2, False),
+        ("join",),
+        ("await_heal", 2, 0, SEEDS),
+    ),
+)
+
+# Hot-key GET flood across every node with one mildly slow drive set:
+# high fan-in reads on a single object stay bit-identical everywhere.
+HOT_KEY_FLOOD = Scenario(
+    name="hot_key_flood",
+    title="hot-key GET flood: fan-in reads bit-identical on all nodes",
+    steps=(
+        ("fault", Fault(node=2, api="read_at", delay_s=0.05, prob=0.5)),
+        ("get_flood", "seed2", 12, 6),
+        ("clear", 2),
+        ("get_flood", "seed2", 5, 2),
+    ),
+)
+
+GRID = (
+    DEAD_REMOTE_DISKS,
+    SLOW_REMOTE_DISKS,
+    PARTITION_MID_PUT,
+    ROLLING_RESTART,
+    HEAL_STORM,
+    HOT_KEY_FLOOD,
+)
+
+
+def scenario_by_name(name: str) -> Scenario:
+    for sc in GRID:
+        if sc.name == name:
+            return sc
+    raise KeyError(name)

@@ -1,0 +1,286 @@
+"""Multi-node cluster harness + chaos grid.
+
+Fast tier: a real 2-node smoke (spawned processes, PUT/GET/heal round
+trip, graceful SIGTERM) plus in-process unit coverage of the pieces
+the harness leans on - the readiness gate, dsync shutdown unwind, the
+admin fault endpoint, lock-plane retry classification, and the
+metrics-merge zero-fill.
+
+Slow tier: the full scenario grid (minio_tpu/testgrid), 3-node
+clusters with remote fault injection, each cell asserting quorum
+invariants (bit-identical reads at quorum or cleanly absent, no torn
+xl.meta, breaker trip + half-open recovery).
+"""
+
+import json
+import os
+
+import pytest
+
+from minio_tpu.cluster.harness import ClusterHarness, parse_prometheus
+
+SECRET = "minioadmin"
+
+
+# -- fast: real 2-node smoke ----------------------------------------------
+
+
+@pytest.fixture()
+def two_node(tmp_path):
+    h = ClusterHarness(tmp_path, nodes=2, drives_per_node=2)
+    with h:
+        yield h
+
+
+def test_two_node_smoke(two_node, tmp_path):
+    """PUT/GET/heal round-trip across two real server processes, then a
+    graceful SIGTERM leaving the survivor serving degraded reads."""
+    h = two_node
+    c1, c2 = h.client(0), h.client(1)
+    assert c1.request("PUT", "/smoke")[0] == 200
+    data = os.urandom(120_000)
+    assert c1.request("PUT", "/smoke/obj", body=data)[0] == 200
+
+    # cross-node read: node2 pulls node1's shards over the wire
+    status, _, body = c2.request("GET", "/smoke/obj")
+    assert status == 200 and body == data
+
+    # both nodes hold shards on disk
+    for n in h.nodes:
+        parts = [
+            p
+            for d in n.drive_dirs
+            for p in d.glob("smoke/obj/*/part.1")
+        ]
+        assert parts, f"no shards on node {n.index + 1}"
+
+    # heal round-trip: lose one shard file, admin heal restores it
+    victim = next(h.nodes[1].drive_dirs[0].glob("smoke/obj/*/part.1"))
+    victim.unlink()
+    status, doc = h.admin(
+        0, "POST", "heal", query={"bucket": "smoke", "object": "obj"}
+    )
+    assert status == 200 and doc.get("healed")
+    assert victim.exists(), "heal did not restore the shard"
+    status, _, body = c2.request("GET", "/smoke/obj")
+    assert status == 200 and body == data
+
+    # readiness reports the subsystem gates, not just liveness
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"{h.nodes[0].endpoint}/minio/health/ready", timeout=5
+    ) as r:
+        doc = json.loads(r.read())
+    assert doc["object_layer"] and doc["lock_plane"] and doc["boot"]
+    assert doc["draining"] is False
+
+    # graceful SIGTERM: drain + lock unwind, clean exit code
+    assert h.terminate(1) == 0
+    assert "shutdown complete" in h.nodes[1].log_tail()
+
+    # survivor serves degraded reads (2/4 drives = data quorum)...
+    status, _, body = c1.request("GET", "/smoke/obj")
+    assert status == 200 and body == data
+    # ...and fails writes cleanly below write quorum (2 < 3)
+    assert c1.request("PUT", "/smoke/obj2", body=b"x" * 999)[0] == 503
+
+
+def test_remote_fault_injection_roundtrip(two_node):
+    """The admin fault endpoint degrades a REMOTE process: errors on
+    node2's drives trip its wire API while node1 keeps serving."""
+    h = two_node
+    c1 = h.client(0)
+    assert c1.request("PUT", "/faulty")[0] == 200
+    data = os.urandom(60_000)
+    assert c1.request("PUT", "/faulty/obj", body=data)[0] == 200
+
+    # read_version fans out to every drive, so the remote rules fire
+    # deterministically (a shard-read fault could be dodged when the
+    # reader's own k local shards satisfy data quorum)
+    h.inject_fault(1, "read_version", error=True)
+    st = h.fault_status(1)
+    assert len(st) == 2  # both drives scheduled
+    assert all(v["rules"] == 1 for v in st.values())
+
+    # degraded read: node2's metadata errors, quorum reconstructs
+    status, _, body = c1.request("GET", "/faulty/obj")
+    assert status == 200 and body == data
+    # the rules actually fired inside the remote process
+    assert any(v["injected"] for v in h.fault_status(1).values())
+
+    h.clear_faults(1)
+    assert all(
+        v["rules"] == 0 for v in h.fault_status(1).values()
+    )
+    status, _, body = c1.request("GET", "/faulty/obj")
+    assert status == 200 and body == data
+
+
+# -- fast: in-process units ------------------------------------------------
+
+
+def test_readiness_gate_semantics():
+    """boot_status=None keeps legacy behaviour (ready == object layer
+    attached); a populated dict gates readiness on every subsystem."""
+    from minio_tpu.server.http import S3Server
+
+    srv = S3Server(None, address="127.0.0.1:0", secret_key=SECRET)
+    try:
+        ok, body = srv.readiness()
+        assert not ok and b'"object_layer": false' in body
+        srv.object_layer = object()
+        ok, _ = srv.readiness()
+        assert ok  # legacy: no boot_status -> object layer suffices
+
+        srv.boot_status = {"lock_plane": False, "boot": False}
+        ok, body = srv.readiness()
+        assert not ok
+        srv.boot_status["lock_plane"] = True
+        srv.boot_status["boot"] = True
+        ok, _ = srv.readiness()
+        assert ok
+        srv.draining = True
+        ok, body = srv.readiness()
+        assert not ok and json.loads(body)["draining"] is True
+    finally:
+        srv.draining = False
+        srv.shutdown()
+
+
+def test_dsync_release_all_unwinds_grants():
+    """release_all must unlock every held entry on every locker - a
+    graceful restart leaves no orphaned entries for peers to expire."""
+    from minio_tpu.dsync.drwmutex import DRWMutex, Dsync
+    from minio_tpu.dsync.local_locker import LocalLocker
+
+    lockers = [LocalLocker(endpoint=f"l{i}") for i in range(3)]
+    ds = Dsync(lockers, refresh_interval_s=60.0)
+    try:
+        m1 = DRWMutex(ds, "vol/obj1")
+        m2 = DRWMutex(ds, "vol/obj2")
+        assert m1.get_lock(timeout=5)
+        assert m2.get_rlock(timeout=5)
+        assert all(len(lk.dump()) == 2 for lk in lockers)
+
+        assert ds.release_all() == 2
+        assert all(len(lk.dump()) == 0 for lk in lockers)
+        # idempotent: nothing held anymore
+        assert ds.release_all() == 0
+    finally:
+        ds.close()
+
+
+def test_admin_fault_endpoint_inprocess(tmp_path):
+    """Routing + validation of fault/inject|clear|status without HTTP."""
+    from minio_tpu.server.admin import AdminAPI
+    from minio_tpu.server.s3errors import S3Error
+    from minio_tpu.storage.faults import FaultDisk
+    from minio_tpu.storage.xl import XLStorage
+
+    class _Srv:
+        object_layer = object()
+
+    srv = _Srv()
+    api = AdminAPI(srv)
+    # disabled: no fault_disks attribute
+    with pytest.raises(S3Error, match="fault injection disabled"):
+        api.handle("GET", "fault/status", {}, b"")
+
+    fd = FaultDisk(XLStorage(str(tmp_path / "fd1")))
+    srv.fault_disks = {str(fd.unwrapped.root): fd}
+    status, body = api.handle(
+        "POST",
+        "fault/inject",
+        {},
+        json.dumps({"api": "read_at", "error": True}).encode(),
+    )
+    assert status == 200 and fd.rule_count() == 1
+    status, body = api.handle("GET", "fault/status", {}, b"")
+    doc = json.loads(body)
+    assert list(doc.values())[0]["rules"] == 1
+
+    # validation: unknown disk selector, missing api
+    with pytest.raises(S3Error, match="no local drive"):
+        api.handle(
+            "POST", "fault/clear", {},
+            json.dumps({"disk": "/nope"}).encode(),
+        )
+    with pytest.raises(S3Error, match="missing api"):
+        api.handle("POST", "fault/inject", {}, b"{}")
+
+    status, _ = api.handle(
+        "POST", "fault/clear", {}, json.dumps({"disk": "*"}).encode()
+    )
+    assert status == 200 and fd.rule_count() == 0
+
+
+def test_lock_retry_classification():
+    """Only a refused connection (provably never sent) may retry a
+    non-idempotent grant; releases/refreshes retry on any failure."""
+    from minio_tpu.dsync.lock_rest import _never_sent
+
+    assert _never_sent(ConnectionRefusedError())
+    assert not _never_sent(ConnectionResetError())
+    assert not _never_sent(BrokenPipeError())
+    assert not _never_sent(TimeoutError())
+
+
+def test_metrics_merge_zero_fill(tmp_path):
+    """merged_metrics labels every sample with its node and zero-fills
+    families a live node did not export, so per-node queries can tell
+    'zero' from 'absent'."""
+    h = ClusterHarness(tmp_path, nodes=2, drives_per_node=1)
+
+    class _Fake:
+        def poll(self):
+            return None
+
+    for n in h.nodes:
+        n.proc = _Fake()  # pretend both are alive; scrape is stubbed
+    scrapes = {
+        0: (
+            'miniotpu_disk_state{disk="http://127.0.0.1:1/d1"} 2\n'
+            "miniotpu_hedge_launched_total 7\n"
+        ),
+        1: "",  # node2 exports nothing
+    }
+    h.scrape = lambda i: scrapes[i]
+
+    merged = h.merged_metrics()
+    states = merged["miniotpu_disk_state"]
+    assert ({"disk": "http://127.0.0.1:1/d1", "node": "n1"}, 2.0) in states
+    assert ({"node": "n2"}, 0.0) in states  # zero-filled
+    hedge = merged["miniotpu_hedge_launched_total"]
+    assert ({"node": "n1"}, 7.0) in hedge
+    assert ({"node": "n2"}, 0.0) in hedge
+
+
+def test_parse_prometheus():
+    rows = parse_prometheus(
+        "# HELP x y\n# TYPE x counter\n"
+        'x{a="1",b="two words"} 3.5\n'
+        "plain 4\n"
+        "garbage line\n"
+    )
+    assert ("x", {"a": "1", "b": "two words"}, 3.5) in rows
+    assert ("plain", {}, 4.0) in rows
+    assert len(rows) == 2
+
+
+# -- slow: the chaos grid --------------------------------------------------
+
+
+from minio_tpu.testgrid import GRID, run_scenario  # noqa: E402
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "scenario", GRID, ids=[sc.name for sc in GRID]
+)
+def test_chaos_grid(scenario, tmp_path):
+    report = run_scenario(scenario, tmp_path)
+    assert report["objects"] >= scenario.seed_objects
+    assert report["meta_files"] > 0
+    if any(step[0] == "await_breaker" for step in scenario.steps):
+        assert report["breaker_events"], "breaker cycle not observed"

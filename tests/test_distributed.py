@@ -11,10 +11,7 @@ endpoint list (verify-healing.sh style), writes crossing the wire.
 
 import io
 import os
-import subprocess
-import sys
 import time
-import urllib.request
 
 import numpy as np
 import pytest
@@ -270,77 +267,15 @@ def test_full_disk_wipe_and_heal(tmp_path):
     assert out.getvalue() == data
 
 
-# -- multi-process cluster -------------------------------------------------
+# -- multi-process cluster (spawned via the cluster harness) ---------------
 
 
-def _free_port():
-    import socket
+def _thread(fn, *args):
+    import threading
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _spawn_cluster(tmp_path, ports, extra_env=None):
-    """Start one server process per port over a shared 4-drive layout;
-    returns (procs, endpoints)."""
-    dirs = [tmp_path / f"n{i+1}" for i in range(len(ports))]
-    for d in dirs:
-        for i in (1, 2):
-            (d / f"d{i}").mkdir(parents=True)
-    endpoints = [
-        f"http://127.0.0.1:{port}{d}/d{i}"
-        for port, d in zip(ports, dirs)
-        for i in (1, 2)
-    ]
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    env["PYTHONPATH"] = os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
-    )
-    env.update(extra_env or {})
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable, "-m", "minio_tpu.server",
-                "--address", f"127.0.0.1:{port}",
-                "--format-timeout", "60",
-                *endpoints,
-            ],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        for port in ports
-    ]
-    return procs, endpoints
-
-
-def _wait_ready(procs, port, timeout=90):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        for pr in procs:
-            if pr.poll() is not None:
-                out = pr.stdout.read().decode(errors="replace")
-                raise AssertionError(
-                    f"server died rc={pr.returncode}:\n{out}"
-                )
-        try:
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{port}/minio/health/ready",
-                method="GET",
-            )
-            with urllib.request.urlopen(req, timeout=2) as r:
-                if r.status == 200:
-                    return
-        except (urllib.error.HTTPError, OSError):
-            pass
-        time.sleep(0.5)
-    raise AssertionError(f"node :{port} never became ready")
+    t = threading.Thread(target=fn, args=args)
+    t.start()
+    return t
 
 
 @pytest.mark.slow
@@ -348,13 +283,11 @@ def test_cross_node_put_race_serializes(tmp_path):
     """Two processes race PUTs to ONE object; dsync quorum locks must
     serialize them so every GET returns one writer's payload intact
     (never an interleaving or a quorum-broken object)."""
-    ports = [_free_port(), _free_port()]
-    procs, _ = _spawn_cluster(tmp_path, ports)
-    try:
-        for port in ports:
-            _wait_ready(procs, port)
-        c1 = S3Client(f"http://127.0.0.1:{ports[0]}")
-        c2 = S3Client(f"http://127.0.0.1:{ports[1]}")
+    from minio_tpu.cluster.harness import ClusterHarness
+
+    with ClusterHarness(tmp_path, nodes=2, drives_per_node=2) as h:
+        c1 = S3Client(h.nodes[0].endpoint)
+        c2 = S3Client(h.nodes[1].endpoint)
         assert c1.make_bucket("race").status == 200
 
         pay_a = _pay(150_000, seed=10)
@@ -374,19 +307,6 @@ def test_cross_node_put_race_serializes(tmp_path):
             r = c1.get_object("race", "obj")
             assert r.status == 200
             assert r.body in (pay_a, pay_b), "interleaved write!"
-    finally:
-        for pr in procs:
-            if pr.poll() is None:
-                pr.kill()
-                pr.wait(timeout=10)
-
-
-def _thread(fn, *args):
-    import threading
-
-    t = threading.Thread(target=fn, args=args)
-    t.start()
-    return t
 
 
 @pytest.mark.slow
@@ -396,50 +316,23 @@ def test_verify_healing_node_restart(tmp_path):
     NO manual heal call (fresh-disk monitor + heal routine)."""
     import shutil
 
-    ports = [_free_port(), _free_port()]
-    fast_heal = {
-        "MINIO_TPU_FRESH_DISK_INTERVAL_S": "1",
-        "MINIO_TPU_LOCK_REFRESH_S": "1",
-        "MINIO_TPU_LOCK_EXPIRY_S": "4",
-    }
-    procs, endpoints = _spawn_cluster(tmp_path, ports, fast_heal)
-    try:
-        for port in ports:
-            _wait_ready(procs, port)
-        c1 = S3Client(f"http://127.0.0.1:{ports[0]}")
+    from minio_tpu.cluster.harness import ClusterHarness
+
+    with ClusterHarness(tmp_path, nodes=2, drives_per_node=2) as h:
+        c1 = S3Client(h.nodes[0].endpoint)
         assert c1.make_bucket("vhb").status == 200
         objs = {f"obj{i}": _pay(50_000 + i, seed=20 + i) for i in range(3)}
         for name, data in objs.items():
             assert c1.put_object("vhb", name, data).status == 200
 
         # kill node2, wipe one of its drives (drive swap while down)
-        procs[1].kill()
-        procs[1].wait(timeout=10)
-        victim_root = tmp_path / "n2" / "d1"
+        h.kill(1)
+        victim_root = h.nodes[1].drive_dirs[0]
         for entry in os.listdir(victim_root):
             shutil.rmtree(victim_root / entry)
 
         # restart node2 with the same endpoint list
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-        env["PYTHONPATH"] = os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))
-        )
-        env.update(fast_heal)
-        procs[1] = subprocess.Popen(
-            [
-                sys.executable, "-m", "minio_tpu.server",
-                "--address", f"127.0.0.1:{ports[1]}",
-                "--format-timeout", "60",
-                *endpoints,
-            ],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        _wait_ready(procs, ports[1])
+        h.restart(1)
 
         # convergence: every object's shard reappears on the wiped
         # drive without any heal API call
@@ -458,15 +351,10 @@ def test_verify_healing_node_restart(tmp_path):
                 f"never converged; healed={healed} want={want}"
             )
         # data still correct end-to-end from the restarted node
-        c2 = S3Client(f"http://127.0.0.1:{ports[1]}")
+        c2 = S3Client(h.nodes[1].endpoint)
         for name, data in objs.items():
             r = c2.get_object("vhb", name)
             assert r.status == 200 and r.body == data
-    finally:
-        for pr in procs:
-            if pr.poll() is None:
-                pr.kill()
-                pr.wait(timeout=10)
 
 
 @pytest.mark.slow
@@ -474,75 +362,11 @@ def test_two_node_cluster(tmp_path):
     """verify-healing.sh style: 2 real server processes, one endpoint
     list, writes from one node readable from the other, degraded reads
     after a node dies."""
-    p1, p2 = _free_port(), _free_port()
-    n1 = tmp_path / "n1"
-    n2 = tmp_path / "n2"
-    for d in (n1, n2):
-        for i in (1, 2):
-            (d / f"d{i}").mkdir(parents=True)
-    # verify-healing.sh style: endpoints listed individually (no
-    # ellipses) form ONE zone / one 4-drive set spanning both nodes
-    endpoints = [
-        f"http://127.0.0.1:{p1}{n1}/d1",
-        f"http://127.0.0.1:{p1}{n1}/d2",
-        f"http://127.0.0.1:{p2}{n2}/d1",
-        f"http://127.0.0.1:{p2}{n2}/d2",
-    ]
+    from minio_tpu.cluster.harness import ClusterHarness
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    env["PYTHONPATH"] = os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
-    )
-
-    procs = []
-    try:
-        for port in (p1, p2):
-            procs.append(
-                subprocess.Popen(
-                    [
-                        sys.executable, "-m", "minio_tpu.server",
-                        "--address", f"127.0.0.1:{port}",
-                        "--format-timeout", "60",
-                        *endpoints,
-                    ],
-                    env=env,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                )
-            )
-
-        def wait_ready(port, timeout=90):
-            deadline = time.monotonic() + timeout
-            while time.monotonic() < deadline:
-                for pr in procs:
-                    if pr.poll() is not None:
-                        out = pr.stdout.read().decode(errors="replace")
-                        raise AssertionError(
-                            f"server died rc={pr.returncode}:\n{out}"
-                        )
-                try:
-                    req = urllib.request.Request(
-                        f"http://127.0.0.1:{port}/minio/health/ready",
-                        method="GET",
-                    )
-                    with urllib.request.urlopen(req, timeout=2) as r:
-                        if r.status == 200:
-                            return
-                except urllib.error.HTTPError:
-                    pass
-                except OSError:
-                    pass
-                time.sleep(0.5)
-            raise AssertionError(f"node :{port} never became ready")
-
-        wait_ready(p1)
-        wait_ready(p2)
-
-        c1 = S3Client(f"http://127.0.0.1:{p1}")
-        c2 = S3Client(f"http://127.0.0.1:{p2}")
+    with ClusterHarness(tmp_path, nodes=2, drives_per_node=2) as h:
+        c1 = S3Client(h.nodes[0].endpoint)
+        c2 = S3Client(h.nodes[1].endpoint)
         assert c1.make_bucket("dist").status == 200
         data = _pay(300_000, seed=3)
         assert c1.put_object("dist", "obj", data).status == 200
@@ -552,24 +376,22 @@ def test_two_node_cluster(tmp_path):
         assert r.status == 200 and r.body == data
 
         # both nodes' drives hold shards
-        for node_dir in (n1, n2):
-            parts = list(node_dir.glob("d*/dist/obj/*/part.1"))
-            assert parts, f"no shards on {node_dir}"
+        for n in h.nodes:
+            parts = [
+                p
+                for d in n.drive_dirs
+                for p in d.glob("dist/obj/*/part.1")
+            ]
+            assert parts, f"no shards on node {n.index + 1}"
 
         # kill node2: node1 still serves reads (2/4 drives, k=2 met)
-        procs[1].kill()
-        procs[1].wait(timeout=10)
+        h.kill(1)
         r = c1.get_object("dist", "obj")
         assert r.status == 200 and r.body == data
 
         # and writes fail cleanly without write quorum (2 < 3)
         r = c1.put_object("dist", "obj2", b"x" * 1000)
         assert r.status == 503
-    finally:
-        for pr in procs:
-            if pr.poll() is None:
-                pr.kill()
-                pr.wait(timeout=10)
 
 
 def test_remote_writer_retry_has_offsets(remote_pair, tmp_path):

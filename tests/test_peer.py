@@ -210,17 +210,18 @@ def test_cross_node_config_propagation(tmp_path):
     import urllib.request
 
     sys.path.insert(0, "tests")
-    import test_distributed as td
     from s3client import S3Client
 
-    ports = [td._free_port(), td._free_port()]
-    procs, _ = td._spawn_cluster(
-        tmp_path, ports, {"MINIO_TPU_BUCKET_META_TTL_S": "3600"}
-    )
-    try:
-        for port in ports:
-            td._wait_ready(procs, port)
-        c1 = S3Client(f"http://127.0.0.1:{ports[0]}")
+    from minio_tpu.cluster.harness import ClusterHarness
+
+    with ClusterHarness(
+        tmp_path,
+        nodes=2,
+        drives_per_node=2,
+        env={"MINIO_TPU_BUCKET_META_TTL_S": "3600"},
+    ) as h:
+        ports = [n.port for n in h.nodes]
+        c1 = S3Client(h.nodes[0].endpoint)
         assert c1.make_bucket("cfg").status == 200
         assert c1.put_object("cfg", "pub.txt", b"hello peers").status == 200
 
@@ -271,11 +272,6 @@ def test_cross_node_config_propagation(tmp_path):
                 break
             time.sleep(0.25)
         assert status == 200, f"policy never propagated (last {status})"
-    finally:
-        for pr in procs:
-            if pr.poll() is None:
-                pr.kill()
-                pr.wait(timeout=10)
 
 
 def test_handshake_fatal_on_wrong_secret(tmp_path):
@@ -425,16 +421,14 @@ def test_cluster_wide_listen(tmp_path):
     import urllib.parse
 
     sys.path.insert(0, "tests")
-    import test_distributed as td
     from s3client import S3Client
 
-    ports = [td._free_port(), td._free_port()]
-    procs, _ = td._spawn_cluster(tmp_path, ports)
-    try:
-        for port in ports:
-            td._wait_ready(procs, port)
-        c1 = S3Client(f"http://127.0.0.1:{ports[0]}")
-        c2 = S3Client(f"http://127.0.0.1:{ports[1]}")
+    from minio_tpu.cluster.harness import ClusterHarness
+
+    with ClusterHarness(tmp_path, nodes=2, drives_per_node=2) as h:
+        ports = [n.port for n in h.nodes]
+        c1 = S3Client(h.nodes[0].endpoint)
+        c2 = S3Client(h.nodes[1].endpoint)
         assert c1.make_bucket("xwatch").status == 200
 
         got: list = []
@@ -486,8 +480,3 @@ def test_cluster_wide_listen(tmp_path):
         assert seen.wait(timeout=20), "event from node 2 never arrived"
         assert got[0]["Key"] == "xwatch/from-node2.txt"
         assert got[0]["EventName"].startswith("s3:ObjectCreated")
-    finally:
-        for pr in procs:
-            if pr.poll() is None:
-                pr.kill()
-                pr.wait(timeout=10)
