@@ -498,6 +498,182 @@ def bench_get_degraded(
         backend_mod.reset_backend()
 
 
+def bench_cache_micro(
+    n_disks: int = 6,
+    reads: int = 40,
+    zipf_keys: int = 32,
+    zipf_alpha: float = 1.2,
+    zipf_reads: int = 200,
+) -> dict:
+    """Tiered read cache micro: cold (cache off) vs hot (host tier) GET.
+
+    Two sweeps through the real object layer on the native CPU codec:
+    a per-size sweep (64 KiB .. 4 MiB, one hot key) and a Zipf sweep
+    (``zipf_keys`` objects of 256 KiB, rank-``zipf_alpha`` skew, the
+    SAME sampled key sequence replayed in both modes).  Cold runs with
+    MINIO_TPU_READ_CACHE=off (the bisection oracle - today's quorum
+    read path exactly); hot runs with the host tier after a warm-up
+    that lets TinyLFU admit the working set.
+
+    Hard bit-identity gate: in BOTH modes every benchmarked object is
+    read back and compared byte-for-byte against the PUT payload before
+    timing, and the hot phase re-verifies after the timed loop so a
+    cache serving rotted rows fails the bench instead of flattering it.
+    """
+    import io
+    import math
+    import os
+    import shutil
+    import tempfile
+
+    from minio_tpu import cache as rcache
+    from minio_tpu.codec import backend as backend_mod
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.storage import health as disk_health
+    from minio_tpu.storage.xl import XLStorage
+
+    root = tempfile.mkdtemp(prefix="minio-tpu-cachemicro-")
+    saved_be = os.environ.get("MINIO_ERASURE_BACKEND")
+    saved_rc = os.environ.get("MINIO_TPU_READ_CACHE")
+    os.environ["MINIO_ERASURE_BACKEND"] = "cpu"
+    backend_mod.reset_backend()
+    disk_health.reset_registry()
+    rcache.reset_read_cache()
+    try:
+        disks = [XLStorage(f"{root}/d{i}") for i in range(n_disks)]
+        ol = ErasureObjects(disks, block_size=BLOCK)
+        ol.make_bucket("bench")
+        rng = np.random.default_rng(12)
+        sizes = [64 << 10, 256 << 10, 1 << 20, 4 << 20]
+        payloads: dict[str, bytes] = {}
+
+        def put(name, body):
+            payloads[name] = body
+            ol.put_object("bench", name, io.BytesIO(body), len(body))
+
+        for sz in sizes:
+            put(
+                f"obj-{sz}",
+                rng.integers(0, 256, sz, dtype=np.uint8).tobytes(),
+            )
+
+        def pct(lats, q):
+            # nearest-rank, honestly including the worst read
+            return lats[max(0, math.ceil(len(lats) * q) - 1)]
+
+        def timed_get(name):
+            t0 = time.perf_counter()
+            ol.get_object("bench", name, _NullWriter())
+            return time.perf_counter() - t0
+
+        def assert_identical(name):
+            buf = io.BytesIO()
+            ol.get_object("bench", name, buf)
+            got = buf.getvalue()
+            if got != payloads[name]:
+                raise AssertionError(
+                    f"bit-identity gate: {name} read "
+                    f"{len(got)}B != stored {len(payloads[name])}B "
+                    f"(mode={os.environ['MINIO_TPU_READ_CACHE']})"
+                )
+
+        def set_mode(mode):
+            os.environ["MINIO_TPU_READ_CACHE"] = mode
+            rcache.reset_read_cache()
+
+        size_sweep = []
+        for sz in sizes:
+            name = f"obj-{sz}"
+            row = {"object_kib": sz >> 10}
+            for mode, label in (("off", "cold"), ("host", "hot")):
+                set_mode(mode)
+                assert_identical(name)  # also warms/admits in host mode
+                for _ in range(3):
+                    timed_get(name)
+                lats = sorted(timed_get(name) for _ in range(reads))
+                if mode == "host":
+                    assert_identical(name)  # re-verify the cached rows
+                row[f"{label}_p50_ms"] = round(pct(lats, 0.5) * 1e3, 3)
+                row[f"{label}_p99_ms"] = round(pct(lats, 0.99) * 1e3, 3)
+                row[f"{label}_mib_s"] = round(
+                    (sz / (1 << 20)) / max(pct(lats, 0.5), 1e-9), 1
+                )
+            row["hot_speedup_p50"] = round(
+                row["cold_p50_ms"] / max(row["hot_p50_ms"], 1e-9), 2
+            )
+            size_sweep.append(row)
+
+        # Zipf sweep: skewed key popularity over a 256 KiB working set;
+        # both modes replay the identical pre-sampled sequence.
+        zsz = 256 << 10
+        znames = [f"zipf-{i}" for i in range(zipf_keys)]
+        for nm in znames:
+            put(nm, rng.integers(0, 256, zsz, dtype=np.uint8).tobytes())
+        probs = np.arange(1, zipf_keys + 1, dtype=np.float64) ** -zipf_alpha
+        probs /= probs.sum()
+        seq = np.random.default_rng(13).choice(
+            zipf_keys, size=zipf_reads, p=probs
+        )
+        zipf = {
+            "keys": zipf_keys,
+            "object_kib": zsz >> 10,
+            "alpha": zipf_alpha,
+            "reads": zipf_reads,
+        }
+        for mode, label in (("off", "cold"), ("host", "hot")):
+            set_mode(mode)
+            for nm in znames:
+                assert_identical(nm)
+            lats = sorted(timed_get(znames[int(i)]) for i in seq)
+            if mode == "host":
+                for nm in znames:
+                    assert_identical(nm)
+                st = rcache.read_cache_stats()
+                tier = st["tiers"]["host"]
+                looks = tier["hits"] + tier["misses"]
+                zipf["hot_hit_rate"] = round(
+                    tier["hits"] / max(looks, 1), 3
+                )
+                zipf["hot_entries"] = tier["entries"]
+                zipf["admission_rejected"] = st["admission"]["rejected"]
+            zipf[f"{label}_p50_ms"] = round(pct(lats, 0.5) * 1e3, 3)
+            zipf[f"{label}_p99_ms"] = round(pct(lats, 0.99) * 1e3, 3)
+        zipf["hot_speedup_p50"] = round(
+            zipf["cold_p50_ms"] / max(zipf["hot_p50_ms"], 1e-9), 2
+        )
+
+        hot_set = [r for r in size_sweep if r["object_kib"] <= 1024]
+        return {
+            "metric": (
+                "tiered read cache micro (cold=off oracle vs hot=host "
+                f"tier, EC on {n_disks} drives, 1 MiB blocks)"
+            ),
+            "reads_per_cell": reads,
+            "size_sweep": size_sweep,
+            "zipf": zipf,
+            "bit_identical_all_cells": True,
+            "headline_hot_speedup_p50": min(
+                r["hot_speedup_p50"] for r in hot_set
+            ),
+            "headline_gate_3x": all(
+                r["hot_speedup_p50"] >= 3.0 for r in hot_set
+            ),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+        disk_health.reset_registry()
+        if saved_be is None:
+            os.environ.pop("MINIO_ERASURE_BACKEND", None)
+        else:
+            os.environ["MINIO_ERASURE_BACKEND"] = saved_be
+        if saved_rc is None:
+            os.environ.pop("MINIO_TPU_READ_CACHE", None)
+        else:
+            os.environ["MINIO_TPU_READ_CACHE"] = saved_rc
+        backend_mod.reset_backend()
+        rcache.reset_read_cache()
+
+
 def bench_put_readback(
     obj_mib: int = 4, n_disks: int = 6, puts: int = 8
 ) -> dict:
@@ -1195,6 +1371,13 @@ def main() -> None:
         "drain, on-disk shard bit-identity) and print its JSON",
     )
     ap.add_argument(
+        "--cache-micro",
+        action="store_true",
+        help="run ONLY the tiered read cache micro (cold=off oracle vs "
+        "hot=host tier, size sweep + Zipf skew, bit-identity gated) "
+        "and print its JSON (BENCH_r12 schema)",
+    )
+    ap.add_argument(
         "--concurrency",
         action="store_true",
         help="run ONLY the request-plane concurrency sweep (1..64 "
@@ -1220,6 +1403,9 @@ def main() -> None:
         return
     if args.get_degraded:
         print(json.dumps(bench_get_degraded(), indent=1))
+        return
+    if args.cache_micro:
+        print(json.dumps(bench_cache_micro(), indent=1))
         return
     if args.put_readback:
         print(json.dumps(bench_put_readback(), indent=1))

@@ -63,6 +63,15 @@ RULES: "dict[str, str]" = {
         "from the partition-rule table (rules.spec_for), the single "
         "source of truth the compile seam fingerprints"
     ),
+    "MTPU110": (
+        "object-data mutation outside the read-cache invalidation seam: "
+        "a function in objectlayer/erasure_object.py or "
+        "erasure_multipart.py that calls rename_data/delete_version (or "
+        "delete_file/write_metadata/update_metadata on a non-SYS_VOL "
+        "volume) must also call the invalidation seam "
+        "(_invalidate_read_cache / cache.invalidate_object), or peers "
+        "serve stale cached groups and FileInfo"
+    ),
     "MTPU201": "kernel contract: wrong output dtype from a jitted entry point",
     "MTPU202": "kernel contract: wrong output shape from a jitted entry point",
     "MTPU203": (

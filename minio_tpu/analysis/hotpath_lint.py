@@ -54,6 +54,24 @@ _PARITY_SCOPE_PREFIXES = ("minio_tpu/ops/",)
 _PARITY_SCOPE_FILES = ("minio_tpu/codec/backend.py",)
 _PARITY_SEAM_RE = re.compile(r"(_end$|drain)")
 
+# MTPU110: object-data mutations must flow through the read-cache
+# invalidation seam.  Any function in the erasure object layer that
+# renames a generation in, deletes a version, or deletes object data
+# files leaves stale digest-verified groups in the tiered read cache
+# (local AND on peers) unless it also calls the invalidation seam.
+# Staging mutations on SYS_VOL (tmp uploads, probe files) touch no
+# committed object data and are exempt.
+_MUTATION_SCOPE_FILES = (
+    "minio_tpu/objectlayer/erasure_object.py",
+    "minio_tpu/objectlayer/erasure_multipart.py",
+)
+_MUTATION_ATTRS = {"rename_data", "delete_version"}
+# mutations whose first argument names the volume: staging writes to
+# SYS_VOL are exempt, anything on a real bucket is a mutation (the
+# metadata writers joined when the FileInfo side-car landed — stale
+# xl.meta is as much a cache bug as stale shard groups)
+_MUTATION_VOL_ATTRS = {"delete_file", "write_metadata", "update_metadata"}
+
 # MTPU109: hand-written PartitionSpec literals.  parallel/rules.py is
 # the single source of truth for shardings (pattern -> PartitionSpec,
 # fingerprinted into the compile-seam cache key); a spec literal
@@ -176,6 +194,7 @@ class _Linter(ast.NodeVisitor):
             rel_path.startswith(_SPEC_SCOPE_PREFIXES)
             and rel_path not in _SPEC_EXEMPT_FILES
         )
+        self.mutation_scope = rel_path in _MUTATION_SCOPE_FILES
         self.findings: "list[Finding]" = []
         # stack of (func_name, jit_static_names or None)
         self._funcs: "list[tuple[str, set | None]]" = []
@@ -224,6 +243,8 @@ class _Linter(ast.NodeVisitor):
                     static.add(params[i])
             self._check_retrace(node, static)
             break
+        if self.mutation_scope:
+            self._check_mutation_invalidate(node)
         self._funcs.append((node.name, static))
         self._async_stack.append(isinstance(node, ast.AsyncFunctionDef))
         self.generic_visit(node)
@@ -242,6 +263,56 @@ class _Linter(ast.NodeVisitor):
                     if isinstance(a, ast.Call):
                         self._awaited.add(id(a))
         self.generic_visit(node)
+
+    def _check_mutation_invalidate(self, node) -> None:
+        """MTPU110: object-data mutation outside the invalidation seam.
+
+        Each def is analyzed on its OWN body: nested defs are skipped
+        (they are visited — and judged — separately), while lambdas
+        stay attached to the enclosing def (_put_object stages its
+        rename_data inside retry lambdas).  A mutation is rename_data/
+        delete_version anywhere, or delete_file/write_metadata/
+        update_metadata on a volume that is not the SYS_VOL staging
+        area (metadata writers count: the FileInfo side-car caches
+        xl.meta too); the seam is any call whose name contains
+        "invalidate".
+        """
+        mutations: "list[tuple[str, ast.Call]]" = []
+        has_seam = False
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Call):
+                fn = n.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else ""
+                )
+                if "invalidate" in name.lower():
+                    has_seam = True
+                elif name in _MUTATION_ATTRS:
+                    mutations.append((name, n))
+                elif name in _MUTATION_VOL_ATTRS and n.args:
+                    first = n.args[0]
+                    if not (
+                        isinstance(first, ast.Name)
+                        and first.id == "SYS_VOL"
+                    ):
+                        mutations.append((name, n))
+            stack.extend(ast.iter_child_nodes(n))
+        if has_seam:
+            return
+        for name, call in mutations:
+            self._emit(
+                "MTPU110",
+                call,
+                f"{name}(...) mutates committed object data but "
+                f"{node.name!r} never calls the read-cache invalidation "
+                "seam; call self._invalidate_read_cache(bucket, object) "
+                "(cache.invalidate_object) so local and peer cached "
+                "groups are dropped before the mutation is acked",
+            )
 
     def _check_retrace(self, node, static: "set[str]") -> None:
         args = node.args

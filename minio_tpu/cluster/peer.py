@@ -545,6 +545,22 @@ class PeerRESTServer:
             )
         return {"ok": True}
 
+    def _invalidate_read_cache(self, q, body) -> dict:
+        """Drop this node's tiered-read-cache entries for one object
+        (the cross-node half of the mutation seam).  Local-only by
+        construction: re-broadcasting here would ping-pong the
+        invalidation around the cluster forever."""
+        from .. import cache as rcache
+
+        bucket = _q1(q, "bucket")
+        obj = _q1(q, "object")
+        if not bucket or not obj:
+            return {"ok": False, "error": "bucket and object required"}
+        return {
+            "ok": True,
+            "dropped": rcache.invalidate_local(bucket, obj),
+        }
+
     _METHODS = {
         "health": _health,
         "serverinfo": _server_info,
@@ -592,6 +608,8 @@ class PeerRESTServer:
         "listenon": _listen_on,
         "listenbuf": _listen_buf,
         "listenoff": _listen_off,
+        # tiered read cache coherence
+        "invalidatereadcache": _invalidate_read_cache,
     }
 
     # -- dispatch (internode-plane calling convention) --------------------
@@ -777,6 +795,13 @@ class PeerRESTClient:
     def listen_off(self, lid: str) -> None:
         self.call("listenoff", {"id": lid}, retry=False)
 
+    def invalidate_read_cache(self, bucket: str, obj: str) -> None:
+        self.call(
+            "invalidatereadcache",
+            {"bucket": bucket, "object": obj},
+            retry=False,
+        )
+
     def is_online(self) -> bool:
         try:
             return bool(self.health().get("ok"))
@@ -849,6 +874,13 @@ class PeerNotifier:
 
     def config_changed(self) -> None:
         self._fanout(lambda c: c.load_config())
+
+    def read_cache_invalidated(self, bucket: str, obj: str) -> None:
+        """Cross-node mutation seam: peers drop their cached groups of
+        (bucket, obj).  Fire-and-forget — a missed invalidation only
+        strands entries keyed by a dead data_dir, which the lookup path
+        can never reach (generation keying is the safety net)."""
+        self._fanout(lambda c: c.invalidate_read_cache(bucket, obj))
 
     def _gather(self, fn, fallback):
         """Query every peer concurrently on the pool: the wall time for

@@ -65,6 +65,16 @@ class ParityPlaneCache:
         self.added = 0
         self.evictions = 0
 
+    def _account(self) -> None:
+        """Report occupancy to the shared device-byte ledger this plane
+        splits with the read cache (cache/allocator.py). Lock held."""
+        try:
+            from ..cache.allocator import device_budget
+
+            device_budget().set_usage("parity_plane", self._bytes)
+        except Exception as exc:  # noqa: BLE001 - must never fail I/O
+            _log.debug("parity budget accounting failed: %s", exc)
+
     def add(self, ref) -> None:
         while True:
             victim = None
@@ -73,6 +83,7 @@ class ParityPlaneCache:
                     self._refs[id(ref)] = ref
                     self._bytes += ref.nbytes
                     self.added += 1
+                    self._account()
                 if self._bytes > self.capacity:
                     for r in self._refs.values():
                         if r is not ref:
@@ -89,6 +100,7 @@ class ParityPlaneCache:
         with self._mu:
             if self._refs.pop(id(ref), None) is not None:
                 self._bytes -= ref.nbytes
+                self._account()
 
     def pressure(self) -> float:
         """Occupancy over budget; >= 1.0 means the batcher should back
@@ -877,3 +889,9 @@ def reset_backend() -> None:
     with _lock:
         _backend = None
         _PARITY_CACHE = None
+    try:
+        from ..cache.allocator import device_budget
+
+        device_budget().set_usage("parity_plane", 0)
+    except Exception as exc:  # noqa: BLE001
+        _log.debug("parity budget reset failed: %s", exc)

@@ -40,6 +40,7 @@ import json
 import threading
 import time
 
+from .. import cache as rcache
 from ..ilm import Action, Lifecycle, LifecycleError
 from ..objectlayer.api import META_BUCKET
 
@@ -444,6 +445,17 @@ class DataCrawler:
                 if oi.is_latest and not oi.delete_marker:
                     bu.objects += 1
                     bu.size += oi.size
+                    # read-cache heat: a live latest version earns one
+                    # admission-frequency credit, so objects that
+                    # survive crawls win the TinyLFU contest against
+                    # one-shot scan traffic before their first GET
+                    try:
+                        rcache.seed_heat(bucket, oi.name, hits=1)
+                    except Exception as exc:  # noqa: BLE001
+                        _log.debug(
+                            "read-cache heat seed failed",
+                            extra=kv(err=str(exc)),
+                        )
                     if self._heal_sweep:
                         self._probe_heal(bucket, oi)
                     if fifo:

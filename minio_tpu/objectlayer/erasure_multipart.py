@@ -505,6 +505,9 @@ class MultipartMixin:
                     except Exception as exc:
                         _log.debug("tmp cleanup during complete rollback failed", extra=kv(err=str(exc)))
                 raise
+            # mutation seam: the completed upload is the object's new
+            # generation — cached groups of the old one die everywhere
+            self._invalidate_read_cache(bucket, object_name)
             if old_data_dir and old_data_dir != data_dir:
                 for d in disks:
                     if d is None:

@@ -13,6 +13,7 @@ import os
 import time
 import uuid
 
+from .. import cache as rcache
 from ..codec import compress as compmod, erasure as ecodec, sse as ssemod
 from ..codec.erasure import Erasure, QuorumError
 from ..parallel import iopool
@@ -301,6 +302,11 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         disks = shuffle_disks(self._online_disks(), distribution)
 
         data_dir = uuid.uuid4().hex
+        # mutation seam: every prior generation's cached groups die
+        # (here and on every peer) BEFORE the new generation encodes,
+        # so the PUT-side populate below never races its own stale keys
+        self._invalidate_read_cache(bucket, object_name)
+        rctx = rcache.context_for(bucket, object_name, data_dir, 1)
         tmp_ids = [uuid.uuid4().hex for _ in range(n)]
         writers: list = []
         for i, d in enumerate(disks):
@@ -329,9 +335,11 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         )
         try:
             total = er.encode(
-                src, writers, self.write_quorum, parity_band=band
+                src, writers, self.write_quorum, parity_band=band,
+                cache_ctx=rctx,
             )
         except QuorumError as e:
+            self._invalidate_read_cache(bucket, object_name)
             # close writers FIRST: streaming remote writers own sender
             # threads that must terminate before staging is reaped
             for w in writers:
@@ -427,6 +435,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         try:
             reduce_errs(errs, self.write_quorum, WriteQuorumError)
         except WriteQuorumError:
+            self._invalidate_read_cache(bucket, object_name)
             self._cleanup_tmp(disks, tmp_ids)
             raise
         # MRF: quorum met but some disks missed the write - queue the
@@ -477,6 +486,19 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             version_id=version_id,
             user_defined=meta,
         )
+
+    @staticmethod
+    def _invalidate_read_cache(bucket, object_name) -> None:
+        """The cache-invalidation seam (MTPU110): every path that
+        mutates object data — PUT, overwrite, heal, delete, multipart
+        commit — flows through here so the tiered read cache (local
+        AND every peer's) never serves a dead generation."""
+        try:
+            rcache.invalidate_object(bucket, object_name)
+        except Exception as exc:  # noqa: BLE001 - never fail the write
+            _log.debug(
+                "read-cache invalidate failed", extra=kv(err=str(exc))
+            )
 
     def _cleanup_tmp(self, disks, tmp_ids) -> None:
         for i, d in enumerate(disks):
@@ -575,6 +597,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 except Exception as e:  # noqa: BLE001
                     errs.append(e)
             reduce_errs(errs, self.write_quorum, WriteQuorumError)
+            self._invalidate_read_cache(bucket, object_name)
             fi.metadata = merged
             return self._to_object_info(bucket, object_name, fi)
 
@@ -715,9 +738,19 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
         check_object_name(object_name)
         self._require_bucket(bucket)
         with self.nslock.read(bucket, object_name):
-            fi, fis = self._read_quorum_fileinfo(
-                bucket, object_name, version_id
-            )
+            # latest-version GETs consult the read cache's FileInfo
+            # side-car before fanning xl.meta reads across the set; the
+            # namespace lock orders the store against any mutation's
+            # post-commit invalidate, so a cached FileInfo is never
+            # staler than what an uncached quorum read would return
+            rc = rcache.read_cache() if not version_id else None
+            fi = rc.meta_lookup(bucket, object_name) if rc else None
+            if fi is None:
+                fi, _ = self._read_quorum_fileinfo(
+                    bucket, object_name, version_id
+                )
+                if rc is not None and not fi.deleted:
+                    rc.meta_store(bucket, object_name, fi)
             if fi.deleted:
                 raise ObjectNotFound(f"{bucket}/{object_name}")
             compressed = bool(fi.metadata.get(compmod.META_COMPRESSION))
@@ -798,19 +831,39 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 else:
                     sink = writer
                     dec_off, dec_len = in_off, in_len
-                readers = self._part_readers(
-                    disks, bucket, object_name, fi, part.number
+                rctx = rcache.context_for(
+                    bucket, object_name, fi.data_dir, part.number
                 )
+                opened: list = []
+                if rctx is None:
+                    # cache off: today's eager-open path, bit for bit
+                    readers = self._part_readers(
+                        disks, bucket, object_name, fi, part.number
+                    )
+                    opened = readers
+                else:
+                    # lazy open: a part whose every group hits the
+                    # cache never opens a shard stream — the "zero
+                    # disk calls on hit" the chaos grid meters
+                    def readers(
+                        _opened=opened, _pn=part.number
+                    ):
+                        rs = self._part_readers(
+                            disks, bucket, object_name, fi, _pn
+                        )
+                        _opened.extend(rs)
+                        return rs
                 try:
                     # decode returns early (heal verdict intact) once a
                     # downstream skipping writer's range is satisfied
                     _, healed = er.decode(
-                        sink, readers, dec_off, dec_len, part.size
+                        sink, readers, dec_off, dec_len, part.size,
+                        cache_ctx=rctx,
                     )
                 except QuorumError as e:
                     raise ReadQuorumError(str(e)) from e
                 finally:
-                    for r in readers:
+                    for r in opened:
                         if r is not None:
                             try:
                                 r.close()
@@ -891,6 +944,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                 except Exception as e:  # noqa: BLE001
                     errs.append(e)
             reduce_errs(errs, self.write_quorum, WriteQuorumError)
+            self._invalidate_read_cache(bucket, object_name)
             return ObjectInfo(
                 bucket=bucket,
                 name=object_name,
@@ -929,6 +983,7 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
             except Exception as e:  # noqa: BLE001
                 errs.append(e)
         reduce_errs(errs, self.write_quorum, WriteQuorumError)
+        self._invalidate_read_cache(bucket, object_name)
         if old_null_dir:
             # the replaced null version's data is unreferenced now
             for d in disks:
@@ -1380,6 +1435,10 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                     SYS_VOL, f"tmp/{tmp_ids[i]}", hfi, bucket, object_name
                 )
                 result["healed"].append(i)
+            # heal rewrote shard files: even though the reconstructed
+            # bytes are identical, cached generations must re-verify
+            # against the fresh frames, so drop them everywhere
+            self._invalidate_read_cache(bucket, object_name)
             return result
 
     def storage_info(self) -> dict:

@@ -426,6 +426,13 @@ def main(argv=None) -> int:
     srv.local_locker = lock_rest.locker if lock_rest is not None else None
     if peers:
         srv.peer_notifier = peer_mod.PeerNotifier(peers)
+        # tiered read cache: object mutations on this node drop every
+        # peer's cached groups through the notifier fan-out
+        from .. import cache as rcache_mod
+
+        rcache_mod.set_broadcast(
+            srv.peer_notifier.read_cache_invalidated
+        )
 
     srv.start()
     print(f"minio-tpu listening at {srv.endpoint} (bootstrapping)")

@@ -112,6 +112,16 @@ class AdminAPI:
             if stats_fn is None:
                 return 200, _json({"enabled": False})
             return 200, _json({"enabled": True, **stats_fn()})
+        # tiered read cache (cache/tiered.py): device+host tiers of
+        # digest-verified encoded groups in front of the quorum reader
+        if route == ("GET", "read-cache-stats"):
+            from .. import cache as rcache
+
+            return 200, _json(rcache.read_cache_stats())
+        if route == ("POST", "read-cache-clear"):
+            from .. import cache as rcache
+
+            return 200, _json({"cleared": rcache.clear_read_cache()})
         # codec kernel telemetry dump (codec/telemetry.py): per-op
         # calls/bytes/device-seconds, batcher occupancy, stream totals
         if route == ("GET", "kernel-stats"):
@@ -492,6 +502,11 @@ class AdminAPI:
             if getattr(self.s3, "plane_stats", None) is not None
             else {},
         }
+        # tiered read cache: zero-filled when off, so the OBD shape is
+        # stable across modes (cache/__init__.py read_cache_stats)
+        from .. import cache as rcache
+
+        doc["read_cache"] = rcache.read_cache_stats()
         try:
             page = _os.sysconf("SC_PAGE_SIZE")
             doc["mem_total_bytes"] = page * _os.sysconf("SC_PHYS_PAGES")

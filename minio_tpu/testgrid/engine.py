@@ -63,6 +63,10 @@ class Scenario:
     seed_objects: int = 4
     object_size: int = 48_000
     steps: tuple = ()
+    # extra environment for every node process, as a tuple of
+    # (name, value) pairs (frozen dataclasses need hashable fields);
+    # the driver's env still wins on conflicts
+    env: tuple = ()
     # invariant toggles (the sweep itself is shared)
     check_meta: bool = True
     check_reads: bool = True
@@ -84,6 +88,8 @@ class _Ctx:
         self.threads: "list[threading.Thread]" = []
         self.errors: "list[str]" = []
         self.breaker_log: "list[str]" = []
+        # cross-step measurements (latency percentiles, counter marks)
+        self.marks: "dict[str, float]" = {}
 
     def confirm(self, key: str, body: bytes) -> None:
         self.objects[key] = [body]
@@ -165,13 +171,15 @@ def _step_join(ctx: _Ctx, timeout_s: float = 120.0) -> None:
     ctx.threads.clear()
 
 
-def _step_get_flood(
-    ctx: _Ctx, key: str, count: int, threads: int = 4
-) -> None:
+def _flood(
+    ctx: _Ctx, key: str, count: int, threads: int
+) -> "list[float]":
     """Hot-key read storm from every node; every reply must be 200 and
-    bit-identical to an acceptable payload."""
+    bit-identical to an acceptable payload.  Returns the per-request
+    wall latencies of the successful reads."""
     ok_bodies = ctx.objects[key]
     fails: list[str] = []
+    latencies: list[float] = []
 
     import http.client as _hc
 
@@ -184,6 +192,7 @@ def _step_get_flood(
             # hiccup, not a correctness violation: one retry on a
             # fresh connection; only a persistent failure counts
             for attempt in (0, 1):
+                t0 = time.monotonic()
                 try:
                     status, _, body = _get(ctx, node, key)
                 except (OSError, _hc.HTTPException):
@@ -192,6 +201,8 @@ def _step_get_flood(
                     continue
                 if status != 200 or body not in ok_bodies:
                     fails.append(f"n{node + 1}#{j}: HTTP {status}")
+                else:
+                    latencies.append(time.monotonic() - t0)
                 break
 
     ts = [
@@ -207,6 +218,158 @@ def _step_get_flood(
             f"get flood on {key}: {len(fails)} bad reads "
             f"(first: {fails[0]})"
         )
+    return latencies
+
+
+def _step_get_flood(
+    ctx: _Ctx, key: str, count: int, threads: int = 4
+) -> None:
+    _flood(ctx, key, count, threads)
+
+
+def _p99(samples: "list[float]") -> float:
+    if not samples:
+        raise AssertionError("no latency samples collected")
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+
+def _step_timed_get_flood(
+    ctx: _Ctx, key: str, count: int, threads: int, mark: str
+) -> None:
+    """get_flood + record the p99 latency under ``mark``."""
+    ctx.marks[mark] = _p99(_flood(ctx, key, count, threads))
+
+
+def _step_assert_p99_within(
+    ctx: _Ctx,
+    mark: str,
+    baseline: str,
+    factor: float,
+    slack_s: float = 0.0,
+) -> None:
+    """The marked p99 must stay within factor x baseline (plus an
+    absolute slack floor so millisecond-scale noise cannot flake)."""
+    hot, base = ctx.marks[mark], ctx.marks[baseline]
+    limit = max(base * factor, base + slack_s)
+    if hot > limit:
+        raise AssertionError(
+            f"p99 regressed: {mark}={hot * 1e3:.1f}ms vs "
+            f"{baseline}={base * 1e3:.1f}ms (limit {limit * 1e3:.1f}ms)"
+        )
+
+
+# the data-plane shard-read API: one call per shard stream a GET opens.
+# A full-cache-hit GET opens zero (the codec's reader bank is lazy), so
+# the hot-key cache cell can assert the counter does not move at all.
+DATA_READ_API = "read_file_stream"
+
+
+def _data_reads_total(ctx: _Ctx) -> float:
+    from ..cluster.harness import parse_prometheus
+
+    total = 0.0
+    for n in ctx.h.nodes:
+        if not n.alive():
+            continue
+        try:
+            rows = parse_prometheus(ctx.h.scrape(n.index))
+        except OSError:
+            continue
+        for name, labels, value in rows:
+            if (
+                name == "miniotpu_disk_api_calls_total"
+                and labels.get("api") == DATA_READ_API
+            ):
+                total += value
+    return total
+
+
+def _step_mark_data_reads(ctx: _Ctx, mark: str = "data_reads") -> None:
+    ctx.marks[mark] = _data_reads_total(ctx)
+
+
+def _step_assert_data_reads_flat(
+    ctx: _Ctx, mark: str = "data_reads"
+) -> None:
+    before = ctx.marks[mark]
+    now = _data_reads_total(ctx)
+    if now != before:
+        raise AssertionError(
+            f"cache-hit flood touched the data plane: "
+            f"{DATA_READ_API} calls moved {before:.0f} -> {now:.0f}"
+        )
+
+
+def _step_make_bucket(ctx: _Ctx, node: int, name: str) -> None:
+    status, _, _ = ctx.h.client(node).request("PUT", f"/{name}")
+    if status != 200:
+        raise AssertionError(f"make_bucket {name}: HTTP {status}")
+
+
+_REPL_XML = (
+    b"<ReplicationConfiguration>"
+    b"<Rule><Status>Enabled</Status><Priority>1</Priority>"
+    b"<Prefix></Prefix>"
+    b"<Destination><Bucket>%s</Bucket></Destination></Rule>"
+    b"</ReplicationConfiguration>"
+)
+
+
+def _step_enable_replication(ctx: _Ctx, node: int, dst: str) -> None:
+    """Versioning + a catch-all replication rule on the grid bucket,
+    targeting a local destination bucket."""
+    c = ctx.h.client(node)
+    status, _, body = c.request(
+        "PUT", f"/{BUCKET}", query={"versioning": ""},
+        body=b"<VersioningConfiguration><Status>Enabled</Status>"
+        b"</VersioningConfiguration>",
+    )
+    if status != 200:
+        raise AssertionError(f"enable versioning: HTTP {status}")
+    status, _, body = c.request(
+        "PUT", f"/{BUCKET}", query={"replication": ""},
+        body=_REPL_XML % dst.encode(),
+    )
+    if status != 200:
+        raise AssertionError(
+            f"replication config: HTTP {status}: {body[:200]!r}"
+        )
+
+
+def _step_await_replication(
+    ctx: _Ctx,
+    node: int,
+    dst: str,
+    keys: tuple,
+    timeout_s: float = 90.0,
+) -> None:
+    """Poll the destination bucket until every key reads back one of
+    its acceptable payloads — the async queue plus the crawler's
+    PENDING/FAILED catch-up must converge with no manual kick."""
+    deadline = time.monotonic() + timeout_s
+    lagging: "dict[str, object]" = {}
+    while time.monotonic() < deadline:
+        lagging = {}
+        for key in keys:
+            ok_bodies = ctx.objects.get(key, [])
+            try:
+                status, _, body = ctx.h.client(node).request(
+                    "GET", f"/{dst}/{key}"
+                )
+            except OSError:
+                lagging[key] = "transport"
+                continue
+            if status != 200:
+                lagging[key] = f"HTTP {status}"
+            elif body not in ok_bodies:
+                lagging[key] = f"stale body ({len(body)} bytes)"
+        if not lagging:
+            return
+        time.sleep(0.5)
+    raise AssertionError(
+        f"replication to {dst} never converged: {lagging}"
+    )
 
 
 def _step_kill(ctx: _Ctx, node: int) -> None:
@@ -349,6 +512,13 @@ _VERBS = {
     "churn": _step_churn,
     "join": _step_join,
     "get_flood": _step_get_flood,
+    "timed_get_flood": _step_timed_get_flood,
+    "assert_p99_within": _step_assert_p99_within,
+    "mark_data_reads": _step_mark_data_reads,
+    "assert_data_reads_flat": _step_assert_data_reads_flat,
+    "make_bucket": _step_make_bucket,
+    "enable_replication": _step_enable_replication,
+    "await_replication": _step_await_replication,
     "kill": _step_kill,
     "terminate": _step_terminate,
     "restart": _step_restart,
@@ -423,11 +593,13 @@ def run_scenario(
 ) -> dict:
     """Execute one grid cell; returns a small report for assertions
     and logging.  Raises AssertionError on any invariant violation."""
+    merged_env = dict(sc.env)
+    merged_env.update(env or {})
     h = ClusterHarness(
         base_dir,
         nodes=sc.nodes,
         drives_per_node=sc.drives_per_node,
-        env=env,
+        env=merged_env,
     )
     with h:
         ctx = _Ctx(h)

@@ -115,6 +115,51 @@ HOT_KEY_FLOOD = Scenario(
     ),
 )
 
+# Hot-key GET flood with the tiered read cache on and one node's
+# drives erroring every shard read: after the warm-up floods, every
+# GET is a full cache hit, so (a) the data-plane shard-read counter
+# must not move AT ALL during the degraded flood — zero disk calls on
+# hit — and (b) GET p99 stays flat against the healthy hot baseline
+# (1.5x plus an absolute noise floor).  Bit-identity holds throughout:
+# the flood compares every body, and the final sweep re-reads every
+# object on every node.
+HOT_KEY_CACHE_FLOOD = Scenario(
+    name="hot_key_cache_flood",
+    title="hot-key flood vs tripped disk: cache hits keep p99 flat",
+    env=(("MINIO_TPU_READ_CACHE", "host"),),
+    steps=(
+        ("get_flood", "seed3", 6, 3),  # warm every node's cache
+        ("timed_get_flood", "seed3", 20, 4, "healthy_p99"),
+        ("mark_data_reads", "flood"),
+        ("fault", Fault(node=1, api="read_file_stream", error=True)),
+        ("fault", Fault(node=1, api="read_at", error=True)),
+        ("timed_get_flood", "seed3", 20, 4, "degraded_p99"),
+        ("assert_data_reads_flat", "flood"),
+        ("assert_p99_within", "degraded_p99", "healthy_p99", 1.5, 0.15),
+        ("clear", 1),
+    ),
+)
+
+# Replication lag under churn: a catch-all rule replicates the grid
+# bucket into a local destination while a writer churns a keyset and
+# one node's shard writes stutter.  After the churn joins and the
+# fault lifts, the destination must converge to an acceptable payload
+# for every churned key — the async queue plus the crawler's
+# PENDING/FAILED catch-up, no manual kick.
+REPLICATION_LAG_CHURN = Scenario(
+    name="replication_lag_churn",
+    title="replication lag under churn: destination converges",
+    steps=(
+        ("make_bucket", 0, "replica"),
+        ("enable_replication", 0, "replica"),
+        ("fault", Fault(node=2, api="write", delay_s=0.05, prob=0.3)),
+        ("churn", 0, 3, 8, 20_000, 500),
+        ("join",),
+        ("clear", 2),
+        ("await_replication", 0, "replica", ("churn0", "churn1", "churn2")),
+    ),
+)
+
 GRID = (
     DEAD_REMOTE_DISKS,
     SLOW_REMOTE_DISKS,
@@ -122,6 +167,8 @@ GRID = (
     ROLLING_RESTART,
     HEAL_STORM,
     HOT_KEY_FLOOD,
+    HOT_KEY_CACHE_FLOOD,
+    REPLICATION_LAG_CHURN,
 )
 
 

@@ -1,0 +1,56 @@
+"""Fixture: object-data mutations outside the invalidation seam.
+
+Linted under rel_path minio_tpu/objectlayer/erasure_object.py (the rule
+is scoped to the two erasure object-layer files); the test asserts the
+exact (rule, line) set below.
+"""
+
+SYS_VOL = ".minio.sys"
+
+
+def put_without_seam(disks, fi, bucket, object_name, tmp):
+    for d in disks:
+        d.rename_data(SYS_VOL, f"tmp/{tmp}", fi, bucket, object_name)  # VIOLATION: MTPU110
+
+
+def delete_without_seam(disks, bucket, object_name, fi):
+    for d in disks:
+        d.delete_version(bucket, object_name, fi)  # VIOLATION: MTPU110
+        d.delete_file(bucket, object_name, recursive=True)  # VIOLATION: MTPU110
+
+
+def staged_rename_in_lambda(disks, fi, bucket, object_name, tmp):
+    # the rename hides inside a retry lambda: still this def's mutation
+    fns = [
+        lambda d=d: d.rename_data(SYS_VOL, f"tmp/{tmp}", fi, bucket, object_name)  # VIOLATION: MTPU110
+        for d in disks
+    ]
+    return [fn() for fn in fns]
+
+
+def outer_seam_does_not_cover_nested(disks, bucket, object_name, fi):
+    # the outer call does NOT excuse the nested def: each def is judged
+    # on its own body
+    invalidate_object(bucket, object_name)
+
+    def drop(d):
+        d.delete_version(bucket, object_name, fi)  # VIOLATION: MTPU110
+
+    for d in disks:
+        drop(d)
+
+
+def tags_update_without_seam(disks, bucket, object_name, fi):
+    # metadata writes are mutations too: the FileInfo side-car would
+    # serve the stale xl.meta forever
+    for d in disks:
+        d.update_metadata(bucket, object_name, fi)  # VIOLATION: MTPU110
+
+
+def delete_marker_without_seam(disks, bucket, object_name, fi):
+    for d in disks:
+        d.write_metadata(bucket, object_name, fi)  # VIOLATION: MTPU110
+
+
+def invalidate_object(bucket, object_name):
+    return 0

@@ -1,0 +1,56 @@
+"""Fixture: the same mutations, flowing through the invalidation seam
+(or exempt because they only touch the SYS_VOL staging area).  Linted
+under rel_path minio_tpu/objectlayer/erasure_object.py; must be clean.
+"""
+
+SYS_VOL = ".minio.sys"
+
+
+class Layer:
+    @staticmethod
+    def _invalidate_read_cache(bucket, object_name):
+        return 0
+
+    def put_with_seam(self, disks, fi, bucket, object_name, tmp):
+        self._invalidate_read_cache(bucket, object_name)
+        for d in disks:
+            d.rename_data(SYS_VOL, f"tmp/{tmp}", fi, bucket, object_name)
+
+    def delete_with_seam(self, disks, bucket, object_name, fi):
+        for d in disks:
+            d.delete_version(bucket, object_name, fi)
+            d.delete_file(bucket, object_name, recursive=True)
+        self._invalidate_read_cache(bucket, object_name)
+
+    def lambda_rename_with_seam(self, disks, fi, bucket, object_name, tmp):
+        self._invalidate_read_cache(bucket, object_name)
+        fns = [
+            lambda d=d: d.rename_data(
+                SYS_VOL, f"tmp/{tmp}", fi, bucket, object_name
+            )
+            for d in disks
+        ]
+        return [fn() for fn in fns]
+
+    def cleanup_tmp_only(self, disks, tmp):
+        # staging-area deletes never touch committed object data
+        for d in disks:
+            d.delete_file(SYS_VOL, f"tmp/{tmp}", recursive=True)
+
+    def tags_update_with_seam(self, disks, bucket, object_name, fi):
+        for d in disks:
+            d.update_metadata(bucket, object_name, fi)
+        self._invalidate_read_cache(bucket, object_name)
+
+    def multipart_staging_meta_only(self, disks, upload_id, fi):
+        # multipart staging metadata lives on SYS_VOL: exempt
+        for d in disks:
+            d.write_metadata(SYS_VOL, f"multipart/{upload_id}", fi)
+
+    def nested_def_with_own_seam(self, disks, bucket, object_name, fi):
+        def drop(d):
+            self._invalidate_read_cache(bucket, object_name)
+            d.delete_version(bucket, object_name, fi)
+
+        for d in disks:
+            drop(d)

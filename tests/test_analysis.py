@@ -366,6 +366,67 @@ def test_mtpu109_silent_outside_sharding_scope():
     )
 
 
+# -- MTPU110: mutations flow through the cache-invalidation seam --------
+#
+# Scope is the two erasure object-layer files; each def is judged on
+# its own body (lambdas attach to the enclosing def, nested defs do
+# not), and delete_file on SYS_VOL (staging) is exempt.
+
+
+def test_bad_mtpu110_exact_findings_under_objectlayer_scope():
+    expected = _expected_markers("bad_mtpu110.py")
+    assert expected, "bad_mtpu110.py declares no VIOLATION markers"
+    got = {
+        (f.rule, f.line)
+        for f in _lint_fixture(
+            "bad_mtpu110.py",
+            rel_path="minio_tpu/objectlayer/erasure_object.py",
+        )
+    }
+    assert got == expected
+
+
+def test_mtpu110_applies_to_multipart_file():
+    got = {
+        (f.rule, f.line)
+        for f in _lint_fixture(
+            "bad_mtpu110.py",
+            rel_path="minio_tpu/objectlayer/erasure_multipart.py",
+        )
+    }
+    assert {
+        (r, ln)
+        for r, ln in _expected_markers("bad_mtpu110.py")
+        if r == "MTPU110"
+    } <= got
+
+
+def test_good_mtpu110_clean_under_objectlayer_scope():
+    found = _lint_fixture(
+        "good_mtpu110.py",
+        rel_path="minio_tpu/objectlayer/erasure_object.py",
+    )
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_mtpu110_silent_outside_objectlayer_scope():
+    """Other objectlayer files (xl_storage, disk cache, healing
+    helpers) mutate via their own seams; the rule keys on the two
+    erasure entry-point files only."""
+    for rel in (
+        "minio_tpu/objectlayer/xl_storage.py",
+        "minio_tpu/storage/bad_mtpu110.py",
+    ):
+        found = _lint_fixture("bad_mtpu110.py", rel_path=rel)
+        assert not any(f.rule == "MTPU110" for f in found), "\n".join(
+            f.render() for f in found
+        )
+
+
+def test_mtpu110_in_rule_catalog():
+    assert "MTPU110" in RULES
+
+
 def test_noqa_suppresses_matching_rule():
     found = _lint_fixture("noqa_suppressed.py")
     assert found == [], "\n".join(f.render() for f in found)

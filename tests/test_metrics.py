@@ -478,6 +478,73 @@ def test_server_plane_render_unit():
     assert sheds["quota"] == 0.0 and sheds["tenant"] == 0.0
 
 
+def test_read_cache_families_zero_filled_when_off():
+    """With the tiered read cache off, render() still carries every
+    miniotpu_cache_* family with one zero sample per tier."""
+    from minio_tpu import cache as rcache
+
+    rcache.reset_read_cache()
+    families = parse_exposition(Metrics().render().decode())
+    for fam_name, mtype in (
+        ("miniotpu_cache_hits_total", "counter"),
+        ("miniotpu_cache_misses_total", "counter"),
+        ("miniotpu_cache_evictions_total", "counter"),
+        ("miniotpu_cache_rejects_total", "counter"),
+        ("miniotpu_cache_entries", "gauge"),
+        ("miniotpu_cache_occupancy_bytes", "gauge"),
+        ("miniotpu_cache_budget_bytes", "gauge"),
+    ):
+        fam = get_family(families, fam_name)
+        assert fam["type"] == mtype
+        cells = {lab["tier"]: v for _n, lab, v in fam["samples"]}
+        assert cells == {"device": 0.0, "host": 0.0}, fam_name
+    for fam_name in (
+        "miniotpu_cache_demotions_total",
+        "miniotpu_cache_invalidations_total",
+    ):
+        fam = get_family(families, fam_name)
+        assert fam["samples"][0][2] == 0.0
+    fam = get_family(families, "miniotpu_cache_admission_events_total")
+    kinds = {lab["kind"]: v for _n, lab, v in fam["samples"]}
+    assert set(kinds) == {"recorded", "seeded", "admitted", "rejected"}
+    assert all(v == 0.0 for v in kinds.values())
+
+
+def test_read_cache_families_reflect_live_counters(monkeypatch):
+    from minio_tpu import cache as rcache
+
+    monkeypatch.setenv("MINIO_TPU_READ_CACHE", "host")
+    rcache.reset_read_cache()
+    try:
+        c = rcache.read_cache()
+        assert c is not None
+        data = np.zeros((1, 2, 64), dtype=np.uint8)
+        digests = np.zeros((1, 2, 8), dtype=np.uint32)
+        key = ("b", "o", "dd", 1, 0, 1, 64)
+
+        class _BE:
+            @staticmethod
+            def verify(d, g):
+                return np.ones((d.shape[0], d.shape[1]), dtype=bool)
+
+        c.put(key, "b/o", data, digests, source="put")
+        assert c.lookup(_BE, key, "b/o") is not None
+        families = parse_exposition(Metrics().render().decode())
+        fam = get_family(families, "miniotpu_cache_hits_total")
+        cells = {lab["tier"]: v for _n, lab, v in fam["samples"]}
+        assert cells["host"] == 1.0
+        fam = get_family(families, "miniotpu_cache_occupancy_bytes")
+        cells = {lab["tier"]: v for _n, lab, v in fam["samples"]}
+        assert cells["host"] == float(data.nbytes + digests.nbytes)
+        fam = get_family(
+            families, "miniotpu_cache_admission_events_total"
+        )
+        kinds = {lab["kind"]: v for _n, lab, v in fam["samples"]}
+        assert kinds["recorded"] >= 2.0
+    finally:
+        rcache.reset_read_cache()
+
+
 def test_live_server_plane_families(server, client):
     """The live scrape carries the request-plane families: inflight
     counts this very scrape, and all pipeline stages report a depth."""
