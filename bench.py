@@ -932,6 +932,187 @@ def bench_select_scan() -> dict:
     }
 
 
+def bench_select_micro(
+    sizes_mib=(1, 8, 64),
+    selectivities=(0.001, 0.01, 0.1),
+    reps: int = 3,
+) -> dict:
+    """TPU-pushdown select micro: size x selectivity, three engines.
+
+    Each cell scans a synthetic CSV (``v,id,pad`` rows) with
+    ``WHERE s.v > 99999``; selectivity is set by the DATA — a
+    ``sel`` fraction of rows carry a 6-digit ``v`` among 3-digit
+    ones, so the screen's ``deep`` (digit-count) atom flags exactly
+    the matching rows.  This is the engine's designed fast shape:
+    the screened column comes first (row-anchored screen), and the
+    candidate set tracks the true match set, so D2H volume is
+    result-proportional.  Shapes the screen cannot discriminate
+    (``<`` on uniform data, predicates on later columns of
+    mixed-type rows) fall back to the host path via the ratio guard
+    and are covered by correctness tests, not this micro.
+    Engines per cell:
+
+      row             MINIO_TPU_SELECT=row    - the bisection oracle
+      host            MINIO_TPU_SELECT=host   - numpy columnar scan
+      device_stream   MINIO_TPU_SELECT=device - upload + screen + drain
+      device_hot      device over a resident plane (the cache-tier
+                      shape: built once outside the timed loop)
+
+    Hard gates: every engine's decoded Records payload (frame
+    boundaries differ per engine chunk size, so the event stream is
+    unframed first) is byte-identical to the row oracle, and the
+    device cells must finish with ZERO fallbacks — proving the screen ran and only candidate rows (plus
+    the per-chunk anchor row) crossed D2H, so readback is
+    result-proportional rather than plane-proportional.
+    """
+    import io
+    import os
+
+    from minio_tpu.s3select import device as seldev
+    from minio_tpu.s3select.engine import S3Select, SelectRequest
+
+    saved_mode = os.environ.get("MINIO_TPU_SELECT")
+
+    def make_csv(size_mib, sel_frac):
+        rng = np.random.default_rng(size_mib * 1000 + int(sel_frac * 1e4))
+        target = size_mib << 20
+        # ~64 B rows: v (3 or 6) + id (7) + fixed 46-byte pad
+        nrows = target // 64
+        hi = rng.random(nrows) < sel_frac
+        v = np.where(
+            hi,
+            rng.integers(100_000, 1_000_000, nrows),
+            rng.integers(100, 1_000, nrows),
+        )
+        pad = "x" * 46
+        rows = [f"{v[i]},{i:07d},{pad}" for i in range(nrows)]
+        return ("v,id,pad\n" + "\n".join(rows) + "\n").encode(), v
+
+    def unframe(buf):
+        # concatenate Records-event payloads; framing (flush points)
+        # legitimately differs between engines, content must not
+        out = bytearray()
+        off = 0
+        while off < len(buf):
+            total = int.from_bytes(buf[off : off + 4], "big")
+            hlen = int.from_bytes(buf[off + 4 : off + 8], "big")
+            hdrs = buf[off + 12 : off + 12 + hlen]
+            if b"Records" in hdrs:
+                out += buf[off + 12 + hlen : off + total - 4]
+            off += total
+        return bytes(out)
+
+    def run(expr, data, mode, source=None):
+        os.environ["MINIO_TPU_SELECT"] = mode
+        body = (
+            "<SelectObjectContentRequest>"
+            f"<Expression>{expr.replace('<', '&lt;')}</Expression>"
+            "<ExpressionType>SQL</ExpressionType>"
+            "<InputSerialization><CSV><FileHeaderInfo>USE"
+            "</FileHeaderInfo></CSV></InputSerialization>"
+            "<OutputSerialization><CSV/></OutputSerialization>"
+            "</SelectObjectContentRequest>"
+        ).encode()
+        sel = S3Select(SelectRequest.from_xml(body))
+        out = bytearray()
+        t0 = time.perf_counter()
+        if source is not None:
+            sel.evaluate(None, len(data), out.extend, device_source=source)
+        else:
+            sel.evaluate(io.BytesIO(data), len(data), out.extend)
+        return time.perf_counter() - t0, bytes(out)
+
+    cells = []
+    try:
+        for size_mib in sizes_mib:
+            for sel_frac in selectivities:
+                data, _v = make_csv(size_mib, sel_frac)
+                plane = seldev.as_device_plane(
+                    [np.frombuffer(data, dtype=np.uint8)], len(data)
+                )
+                expr = "SELECT s.id FROM S3Object s WHERE s.v > 99999"
+                cell = {
+                    "size_mib": size_mib,
+                    "selectivity": sel_frac,
+                }
+                oracle = None
+                fb0 = sum(
+                    seldev.STATS.snapshot()["fallbacks"].values()
+                )
+                for label, mode, source in (
+                    ("row", "row", None),
+                    ("host", "host", None),
+                    ("device_stream", "device", None),
+                    ("device_hot", "device", plane),
+                ):
+                    # the row oracle is timed once (it only anchors
+                    # the identity + baseline; reps would dominate
+                    # the wall clock at 64 MiB)
+                    n = 1 if label == "row" else reps
+                    run(expr, data, mode, source)  # warm (jit/caches)
+                    best = None
+                    for _ in range(n):
+                        dt, payload = run(expr, data, mode, source)
+                        best = dt if best is None else min(best, dt)
+                    records = unframe(payload)
+                    if oracle is None:
+                        oracle = records
+                        cell["result_bytes"] = len(records)
+                    elif records != oracle:
+                        raise AssertionError(
+                            f"bit-identity gate: {label} diverged at "
+                            f"{size_mib} MiB sel={sel_frac}"
+                        )
+                    cell[f"{label}_s"] = round(best, 4)
+                    cell[f"{label}_mib_s"] = round(
+                        size_mib / max(best, 1e-9), 1
+                    )
+                fb1 = sum(
+                    seldev.STATS.snapshot()["fallbacks"].values()
+                )
+                cell["device_fallbacks"] = fb1 - fb0
+                if fb1 != fb0:
+                    raise AssertionError(
+                        f"device screen fell back at {size_mib} MiB "
+                        f"sel={sel_frac}: D2H not result-proportional"
+                    )
+                cell["speedup_hot_vs_host"] = round(
+                    cell["host_s"] / max(cell["device_hot_s"], 1e-9), 2
+                )
+                cell["speedup_stream_vs_host"] = round(
+                    cell["host_s"] / max(cell["device_stream_s"], 1e-9),
+                    2,
+                )
+                cells.append(cell)
+        gate_cells = [
+            c
+            for c in cells
+            if c["size_mib"] >= 64 and c["selectivity"] <= 0.01
+        ]
+        return {
+            "metric": (
+                "select pushdown micro (device screen vs host vector "
+                "vs row oracle; bit-identity + zero-fallback gated)"
+            ),
+            "reps_per_cell": reps,
+            "cells": cells,
+            "bit_identical_all_cells": True,
+            "headline_hot_speedup": max(
+                (c["speedup_hot_vs_host"] for c in gate_cells),
+                default=None,
+            ),
+            "headline_gate_3x": bool(gate_cells)
+            and all(
+                c["speedup_hot_vs_host"] >= 3.0 for c in gate_cells
+            ),
+        }
+    finally:
+        if saved_mode is None:
+            os.environ.pop("MINIO_TPU_SELECT", None)
+        else:
+            os.environ["MINIO_TPU_SELECT"] = saved_mode
+
+
 def _kernel_stats_snapshot():
     from minio_tpu.codec.telemetry import KERNEL_STATS
 
@@ -1378,6 +1559,13 @@ def main() -> None:
         "and print its JSON (BENCH_r12 schema)",
     )
     ap.add_argument(
+        "--select-micro",
+        action="store_true",
+        help="run ONLY the select pushdown micro (size x selectivity, "
+        "device screen vs host vector vs row oracle, bit-identity + "
+        "zero-fallback gated) and print its JSON (BENCH_r13 schema)",
+    )
+    ap.add_argument(
         "--concurrency",
         action="store_true",
         help="run ONLY the request-plane concurrency sweep (1..64 "
@@ -1406,6 +1594,9 @@ def main() -> None:
         return
     if args.cache_micro:
         print(json.dumps(bench_cache_micro(), indent=1))
+        return
+    if args.select_micro:
+        print(json.dumps(bench_select_micro(), indent=1))
         return
     if args.put_readback:
         print(json.dumps(bench_put_readback(), indent=1))

@@ -72,6 +72,13 @@ RULES: "dict[str, str]" = {
         "(_invalidate_read_cache / cache.invalidate_object), or peers "
         "serve stale cached groups and FileInfo"
     ),
+    "MTPU111": (
+        "eager S3-Select readback: np.asarray/np.array/jax.device_get in "
+        "s3select/device.py outside the result-drain seam (functions "
+        "whose name contains 'drain'); only candidate row bytes may "
+        "cross D2H, or the pushdown degenerates into a whole-plane "
+        "host scan"
+    ),
     "MTPU201": "kernel contract: wrong output dtype from a jitted entry point",
     "MTPU202": "kernel contract: wrong output shape from a jitted entry point",
     "MTPU203": (

@@ -84,6 +84,18 @@ _METRIC_NAME_RE = re.compile(r"^miniotpu_[a-z0-9_]+$")
 _LABEL_KEY_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 _METRIC_TYPES = {"counter", "gauge", "histogram"}
 
+# MTPU111: S3-Select result drain.  The device scan pipeline keeps the
+# object plane and every flag/count word device-resident; candidate row
+# bytes are the ONLY payload that crosses D2H, and only through the
+# drain seam functions in s3select/device.py (``_drain_scalars`` /
+# ``_drain_array`` / ``_drain_fallback_chunk`` / ``drain_plane`` — any
+# function whose name contains "drain").  An eager np.asarray/np.array/
+# jax.device_get anywhere else in that module re-introduces a
+# whole-plane readback and silently turns the pushdown into a host
+# scan.  np.frombuffer is exempt: device.py uses it on host bytes.
+_SELECT_SCOPE_FILES = ("minio_tpu/s3select/device.py",)
+_SELECT_SEAM_RE = re.compile(r"drain")
+
 # MTPU108: event-loop-blocking calls inside ``async def`` bodies of the
 # server plane.  One stalled coroutine stalls every connection on the
 # loop; blocking work belongs on the worker-pool bridge (server/aio.py
@@ -190,6 +202,7 @@ class _Linter(ast.NodeVisitor):
             or rel_path in _PARITY_SCOPE_FILES
         )
         self.loop_scope = rel_path.startswith(_LOOP_SCOPE_PREFIXES)
+        self.select_scope = rel_path in _SELECT_SCOPE_FILES
         self.spec_scope = (
             rel_path.startswith(_SPEC_SCOPE_PREFIXES)
             and rel_path not in _SPEC_EXEMPT_FILES
@@ -334,6 +347,7 @@ class _Linter(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         self._check_sync(node)
         self._check_parity_readback(node)
+        self._check_select_readback(node)
         self._check_partition_literal(node)
         self._check_metric_emit(node)
         self._check_loop_block(node)
@@ -435,6 +449,34 @@ class _Linter(ast.NodeVisitor):
             "host outside the *_end/drain seams; keep the plane "
             "device-resident and route readback through the backend's "
             "digest-only drain",
+        )
+
+    def _check_select_readback(self, node: ast.Call) -> None:
+        """MTPU111: eager D2H outside the select result-drain seam."""
+        if not self.select_scope or not node.args:
+            return
+        if any(_SELECT_SEAM_RE.search(name) for name, _ in self._funcs):
+            return
+        dotted = _dotted(node.func) or ""
+        attr = (
+            node.func.attr
+            if isinstance(node.func, ast.Attribute)
+            else dotted
+        )
+        eager = dotted in ("jax.device_get", "device_get") or (
+            dotted.startswith(("np.", "numpy."))
+            and attr in ("asarray", "array")
+        )
+        if not eager:
+            return
+        root = _root_name(node.args[0]) or "<expr>"
+        self._emit(
+            "MTPU111",
+            node,
+            f"{dotted}({root}...) reads device data back to host "
+            "outside the result-drain seam; only candidate row bytes "
+            "may cross D2H, through the drain functions in "
+            "s3select/device.py",
         )
 
     def _check_sync(self, node: ast.Call) -> None:

@@ -57,7 +57,13 @@ def _ops_modules():
     # attrs; watching them here catches a stray module-level jit, and
     # the seam registry gets its own MTPU204 closure in run().
     from minio_tpu.codec import backend
-    from minio_tpu.ops import codec_step, hash as phash, rs, rs_pallas
+    from minio_tpu.ops import (
+        codec_step,
+        hash as phash,
+        rs,
+        rs_pallas,
+        select_step,
+    )
     from minio_tpu.parallel import mesh, rules
 
     return {
@@ -65,6 +71,7 @@ def _ops_modules():
         "rs_pallas": rs_pallas,
         "codec_step": codec_step,
         "hash": phash,
+        "select_step": select_step,
         "backend": backend,
         "mesh": mesh,
         "rules": rules,
@@ -385,6 +392,138 @@ def run() -> "list[Finding]":
                 c.dtype(acc, "uint32", "probe accumulator")
             except Exception as e:
                 c.fail(e)
+
+    # ---- select_step.py: S3 Select scan kernels -------------------------
+    #
+    # SWAR flag-words are uint64, so every contract evaluates under
+    # enable_x64 exactly like the runtime call sites (the flag is part
+    # of the jit cache key).  The plane grid is tiny — shapes close over
+    # N the same way at 64 MiB as at 4 KiB.
+
+    from jax.experimental import enable_x64
+
+    from minio_tpu.ops import select_step
+
+    u8_ = jnp.uint8
+    _SELECT_PLANES = (4096, 16384)  # bytes; multiples of BLOCK_BYTES
+    # one branch per screen-atom kind, so the contract traces every
+    # _atom_mask arm the compiler can emit
+    _SELECT_ATOMS = (
+        (("len", 0, 3),),
+        (("deep", 2),),
+        (("byte0", 43, 48),),
+        (("nd", 4),),
+        (("lex", b"42", "lt"),),
+        (("lex", b"42", "ge"),),
+        (("lex", b"name", "eq"),),
+    )
+
+    def sel_cfg(n, extra=""):
+        return f"(plane_bytes={n}{extra})"
+
+    with enable_x64():
+        u64 = jnp.uint64
+        wpb = select_step.BLOCK_BYTES // 8  # words per popcount reshape
+
+        covers("select_step", "screen_chunk")
+        c = ctx(select_step.screen_chunk, "minio_tpu/ops/select_step.py")
+        for n in _SELECT_PLANES:
+            for anchor in ("row", "field"):
+                for sci in (False, True):
+                    c.config = sel_cfg(
+                        n, f", anchor={anchor}, sci_guard={sci}"
+                    )
+                    try:
+                        cand, blk, nrows, haz = (
+                            select_step.screen_chunk.eval_shape(
+                                S((n,), u8_),
+                                fd=44,
+                                qc=34,
+                                atoms=_SELECT_ATOMS,
+                                anchor=anchor,
+                                sci_guard=sci,
+                            )
+                        )
+                        c.shape(cand, (n // 8,), "candidate flag words")
+                        c.dtype(cand, "uint64", "candidate flag words")
+                        c.shape(blk, (n // 64,), "block popcounts")
+                        c.dtype(blk, "int32", "block popcounts")
+                        c.shape(nrows, (), "row count")
+                        c.dtype(nrows, "int32", "row count")
+                        c.shape(haz, (), "hazard scalar")
+                        c.dtype(haz, "bool", "hazard scalar")
+                    except Exception as e:
+                        c.fail(e)
+
+        covers("select_step", "extract_positions")
+        c = ctx(
+            select_step.extract_positions, "minio_tpu/ops/select_step.py"
+        )
+        for n in _SELECT_PLANES:
+            for cap in (64, 1024):
+                c.config = sel_cfg(n, f", cap={cap}")
+                try:
+                    pos = select_step.extract_positions.eval_shape(
+                        S((n // 8,), u64),
+                        S((n // 64,), jnp.int32),
+                        cap=cap,
+                    )
+                    c.shape(pos, (cap,), "candidate byte positions")
+                    c.dtype(pos, "int32", "candidate byte positions")
+                except Exception as e:
+                    c.fail(e)
+
+        _C = 7  # candidate count for the windowed kernels
+
+        covers("select_step", "row_spans")
+        c = ctx(select_step.row_spans, "minio_tpu/ops/select_step.py")
+        for n in _SELECT_PLANES:
+            for window in (256, 4096):
+                c.config = sel_cfg(n, f", window={window}")
+                try:
+                    lens, found = select_step.row_spans.eval_shape(
+                        S((n,), u8_), S((_C,), jnp.int32), window=window
+                    )
+                    c.shape(lens, (_C,), "row lengths")
+                    c.dtype(lens, "int32", "row lengths")
+                    c.shape(found, (_C,), "row-end found mask")
+                    c.dtype(found, "bool", "row-end found mask")
+                except Exception as e:
+                    c.fail(e)
+
+        covers("select_step", "anchors_back")
+        c = ctx(select_step.anchors_back, "minio_tpu/ops/select_step.py")
+        for n in _SELECT_PLANES:
+            for window in (256, 1024):
+                c.config = sel_cfg(n, f", window={window}")
+                try:
+                    anch, found = select_step.anchors_back.eval_shape(
+                        S((n,), u8_), S((_C,), jnp.int32), window=window
+                    )
+                    c.shape(anch, (_C,), "row anchors")
+                    c.dtype(anch, "int32", "row anchors")
+                    c.shape(found, (_C,), "anchor found mask")
+                    c.dtype(found, "bool", "anchor found mask")
+                except Exception as e:
+                    c.fail(e)
+
+        covers("select_step", "gather_rows")
+        c = ctx(select_step.gather_rows, "minio_tpu/ops/select_step.py")
+        for n in _SELECT_PLANES:
+            for window in (64, 1024):
+                c.config = sel_cfg(n, f", window={window}")
+                try:
+                    mat = select_step.gather_rows.eval_shape(
+                        S((n,), u8_), S((_C,), jnp.int32), window=window
+                    )
+                    c.shape(mat, (_C, window), "gathered row matrix")
+                    c.dtype(mat, "uint8", "gathered row matrix")
+                except Exception as e:
+                    c.fail(e)
+
+        # sanity: the popcount reshape granularity the contracts assume
+        # (8 words) matches the module's padding contract
+        assert select_step.BLOCK_BYTES % (wpb * 8) == 0
 
     # ---- rs_pallas.py ---------------------------------------------------
 

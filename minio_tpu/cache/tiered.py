@@ -159,6 +159,27 @@ class TieredReadCache:
             self._hits[ent.tier] += 1
         return data
 
+    def device_entries(self, bucket: str, object_name: str) -> dict:
+        """Device-tier group arrays of one object, keyed by full cache
+        key, WITHOUT host materialization — the S3 Select pushdown
+        assembles them into a scan plane entirely on device.
+
+        Device-tier only by design: jax buffers are immutable once
+        put, so the host-side rot re-verification ``lookup`` performs
+        (which would cost a full D2H) does not apply; a host-tier or
+        missing group simply keeps the scan on the spooled read path."""
+        with self._mu:
+            keys = self._index.get((bucket, object_name), ())
+            out = {}
+            for key in keys:
+                e = self._tiers[TIER_DEVICE].get(key)
+                if e is not None:
+                    self._tiers[TIER_DEVICE].move_to_end(key)
+                    out[key] = e.data
+            if out:
+                self._hits[TIER_DEVICE] += len(out)
+            return out
+
     # ---- write side -----------------------------------------------------
 
     def put(

@@ -886,6 +886,77 @@ class ErasureObjects(MultipartMixin, ObjectLayer):
                         _log.debug("deep-heal hook failed", extra=kv(err=str(exc)))
             return info
 
+    def device_scan_source(self, bucket, object_name):
+        """Device-resident scan plane for the S3 Select pushdown, or
+        None when the object cannot be served from the device cache
+        tier (cache off/host-mode, transformed bytes, partial group
+        coverage) — the caller then takes the spooled read path.
+
+        A full hit assembles the object's cached (g, k, shard_len)
+        group arrays into one contiguous byte plane with device-side
+        slicing only: no shard reader opens, no host round-trip.
+        Returns ``(plane, nbytes)`` ready for S3Select.evaluate's
+        ``device_source``."""
+        check_object_name(object_name)
+        self._require_bucket(bucket)
+        with self.nslock.read(bucket, object_name):
+            rc = rcache.read_cache()
+            if rc is None or rc.mode != "device":
+                return None
+            fi = rc.meta_lookup(bucket, object_name)
+            if fi is None:
+                try:
+                    fi, _ = self._read_quorum_fileinfo(
+                        bucket, object_name, ""
+                    )
+                except Exception:  # noqa: BLE001 - miss, not an error
+                    return None
+                if not fi.deleted:
+                    rc.meta_store(bucket, object_name, fi)
+            if fi.deleted or fi.size <= 0:
+                return None
+            if fi.metadata.get(compmod.META_COMPRESSION) or fi.metadata.get(
+                ssemod.META_SSE
+            ):
+                # the cache holds stored bytes; a scan needs plaintext
+                return None
+            entries = rc.device_entries(bucket, object_name)
+            if not entries:
+                return None
+            by_first = {(key[3], key[4]): key for key in entries}
+            er = Erasure(
+                fi.erasure.data_blocks,
+                fi.erasure.parity_blocks,
+                fi.erasure.block_size,
+            )
+            chunks = []
+            for part in fi.parts:
+                nblocks = er.block_count(part.size)
+                b = 0
+                while b < nblocks:
+                    key = by_first.get((part.number, b))
+                    if key is None or key[2] != fi.data_dir:
+                        return None
+                    g, shard_len = key[5], key[6]
+                    data = entries[key]
+                    if b + g > nblocks:
+                        return None
+                    for gi in range(g):
+                        block_len = er._block_len(b + gi, part.size)
+                        if er.shard_size_padded(block_len) != shard_len:
+                            return None
+                        ss = er.shard_size(block_len)
+                        chunks.append(
+                            data[gi, :, :ss].reshape(-1)[:block_len]
+                        )
+                    b += g
+            from ..s3select import device as seldev
+
+            try:
+                return seldev.as_device_plane(chunks, fi.size)
+            except Exception:  # noqa: BLE001 - never fail the select
+                return None
+
     def _part_readers(
         self, disks, bucket, object_name, fi: FileInfo, part_number: int
     ) -> list:

@@ -195,7 +195,24 @@ class S3Select:
             return csvio.CSVWriter(**self.req.csv_writer_args)
         return jsonio.JSONWriter(**self.req.json_writer_args)
 
-    def evaluate(self, stream, scanned_bytes: int, emit) -> None:
+    def device_capable(self) -> bool:
+        """True when this statement could run on the device engine
+        against a device-resident byte plane (the cache-tier scan
+        source): CSV in, no decompression, mode allows, and both the
+        host fast path and the screen compiler accept the shape."""
+        from . import device
+
+        return (
+            device.select_mode() in ("auto", "device")
+            and self.req.input_format == "CSV"
+            and self.req.compression == "NONE"
+            and vector.eligible(self.stmt, self.req)
+            and device.device_eligible(self.stmt, self.req)
+        )
+
+    def evaluate(
+        self, stream, scanned_bytes: int, emit, device_source=None
+    ) -> None:
         """Run the query; ``emit(frame_bytes)`` receives EventStream
         frames ready for the wire.  ``scanned_bytes`` is the stored
         object size (BytesScanned in Stats)."""
@@ -228,21 +245,51 @@ class S3Select:
             if len(batch) >= BATCH_BYTES:
                 flush()
 
+        from . import device
+
+        def _stream():
+            # host engines read a byte stream; a device-resident plane
+            # reaches them through the drain seam exactly once
+            if stream is not None:
+                return stream
+            return io.BytesIO(device.drain_plane(*device_source))
+
+        mode = device.select_mode()
         try:
-            if vector.json_eligible(stmt, self.req):
+            if mode != "row" and vector.json_eligible(stmt, self.req):
                 # flat JSON-lines aggregates: regex column extraction
                 # + the same mask algebra as the CSV columnar scan
+                device.STATS.request("host")
                 vector.FastJSONScan(stmt, self.req).run(
-                    self._decompress(stream)
+                    self._decompress(_stream())
                 )
-            elif vector.eligible(stmt, self.req):
-                # columnar scan: numpy masks instead of per-row eval,
-                # with exact row-engine fallback per chunk
-                vector.FastScan(
-                    stmt, self.req, writer, clean, sink
-                ).run(self._decompress(stream))
+            elif mode != "row" and vector.eligible(stmt, self.req):
+                if mode in ("auto", "device") and device.device_eligible(
+                    stmt, self.req
+                ):
+                    # device pre-filter: conservative SWAR screen on
+                    # the word planes, exact host re-filter of the
+                    # candidate rows (s3select/device.py)
+                    device.STATS.request("device")
+                    scan = device.DeviceScan(
+                        stmt, self.req, writer, clean, sink
+                    )
+                else:
+                    # columnar scan: numpy masks instead of per-row
+                    # eval, with exact row-engine fallback per chunk
+                    device.STATS.request("host")
+                    scan = vector.FastScan(
+                        stmt, self.req, writer, clean, sink
+                    )
+                if device_source is not None and isinstance(
+                    scan, device.DeviceScan
+                ):
+                    scan.run_device(*device_source)
+                else:
+                    scan.run(self._decompress(_stream()))
             else:
-                records = self._records(self._decompress(stream))
+                device.STATS.request("row")
+                records = self._records(self._decompress(_stream()))
                 matched = 0
                 for row in records:
                     if (
@@ -280,6 +327,7 @@ class S3Select:
                     scanned_bytes, scanned_bytes, returned
                 )
             )
+        device.STATS.io(scanned_bytes, returned)
         emit(message.stats_message(scanned_bytes, scanned_bytes, returned))
         emit(message.end_message())
 

@@ -829,8 +829,9 @@ class FastScan:
         esc_mode = a.quote_escape_character != a.quote_character
         esc_byte = ord(a.quote_escape_character)
         carry = b""
+        size = getattr(self, "read_size", CHUNK)
         while not self.done:
-            buf = stream.read(CHUNK)
+            buf = stream.read(size)
             if not buf:
                 break
             data = carry + buf
@@ -843,7 +844,7 @@ class FastScan:
                 return self.matched
             cut = self._safe_cut(data)
             if cut < 0:
-                if len(data) > 4 * CHUNK:
+                if len(data) > 4 * size:
                     # a stray unbalanced quote would otherwise buffer
                     # the whole remaining object into carry
                     self._slow_stream(data, stream)

@@ -507,6 +507,12 @@ class AdminAPI:
         from .. import cache as rcache
 
         doc["read_cache"] = rcache.read_cache_stats()
+        # S3 Select pushdown: engine mix, fallback reasons, scan I/O
+        from ..s3select import device as seldev
+
+        doc["select"] = dict(
+            seldev.STATS.snapshot(), mode=seldev.select_mode()
+        )
         try:
             page = _os.sysconf("SC_PAGE_SIZE")
             doc["mem_total_bytes"] = page * _os.sysconf("SC_PHYS_PAGES")

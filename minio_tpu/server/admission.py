@@ -37,7 +37,7 @@ from ..utils.log import kv, logger
 
 _log = logger("admission")
 
-SHED_REASONS = ("queue", "quota", "tenant")
+SHED_REASONS = ("queue", "quota", "tenant", "select")
 
 # Authorization: AWS4-HMAC-SHA256 Credential=AK/date/region/..., ...
 _CRED_RE = re.compile(r"Credential=([^/,\s]+)/")
@@ -104,11 +104,15 @@ class AdmissionController:
         self.stats = stats
         self._mu = threading.Lock()
         self._tenant_inflight: "dict[str, int]" = {}
+        self._select_inflight = 0
 
     # -- knobs ------------------------------------------------------------
 
     def _tenant_max(self) -> int:
         return _env_int("MINIO_TPU_TENANT_MAX_INFLIGHT", 0)
+
+    def _select_max(self) -> int:
+        return _env_int("MINIO_TPU_SELECT_MAX_INFLIGHT", 0)
 
     # -- tenant stage -----------------------------------------------------
 
@@ -149,6 +153,33 @@ class AdmissionController:
     def tenant_inflight(self) -> "dict[str, int]":
         with self._mu:
             return dict(self._tenant_inflight)
+
+    # -- select stage -----------------------------------------------------
+    #
+    # Scans are a second admitted traffic class: one SELECT can pin a
+    # device submesh and stream megabytes of filtered rows, so an
+    # unbounded scan flood would starve the GET/PUT plane long before
+    # the global inflight cap notices.  The cap is its own knob
+    # (MINIO_TPU_SELECT_MAX_INFLIGHT; 0 = unlimited) and its sheds get
+    # their own reason so the operator can tell scan pressure from
+    # queue pressure.
+
+    def try_enter_select(self) -> bool:
+        """Take a scan slot; False -> shed 503 reason=select."""
+        limit = self._select_max()
+        with self._mu:
+            if limit > 0 and self._select_inflight >= limit:
+                return False
+            self._select_inflight += 1
+            return True
+
+    def leave_select(self) -> None:
+        with self._mu:
+            self._select_inflight = max(0, self._select_inflight - 1)
+
+    def select_inflight(self) -> int:
+        with self._mu:
+            return self._select_inflight
 
     # -- quota stage ------------------------------------------------------
 

@@ -1,13 +1,19 @@
 """SelectObjectContent glue (cmd/object-handlers.go:91
 SelectObjectContentHandler -> pkg/s3select).
 
-The object is spooled through the normal erasure-decode read path
-(decompression/SSE seams included), evaluated by minio_tpu.s3select,
-and the EventStream frames are written as one response.
+Scans are the server's second admitted traffic class
+(MINIO_TPU_SELECT_MAX_INFLIGHT, shed reason ``select``).  A
+device-capable statement over an object whose groups all sit in the
+device cache tier scans the device-resident plane directly — zero
+shard reads, candidate rows only across D2H; everything else is
+spooled through the normal erasure-decode read path
+(decompression/SSE seams included) and evaluated on host.  Either
+way the EventStream frames are written as one response.
 """
 
 from __future__ import annotations
 
+import io
 import tempfile
 
 from ..s3select import S3Select, SelectError
@@ -16,6 +22,47 @@ from .s3errors import S3Error
 
 # spool to disk past this size; select sources are usually small-ish
 SPOOL_MEM = 16 << 20
+
+
+class _SpoolReader(io.RawIOBase):
+    """Readable adapter over SpooledTemporaryFile.
+
+    Until Python 3.11 SpooledTemporaryFile does not implement the io
+    ABC probes (``readable()`` & co.), so handing the spool straight
+    to the select engines blows up inside their TextIOWrapper."""
+
+    def __init__(self, spool):
+        self._spool = spool
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        data = self._spool.read(len(b))
+        n = len(data)
+        b[:n] = data
+        return n
+
+
+def _spool_reader(spool):
+    return spool if hasattr(spool, "readable") else _SpoolReader(spool)
+
+
+def _device_source(handler, bucket, key, info, sel):
+    """(plane, nbytes) when this scan can run on the device cache
+    tier, else None.  Never raises: any wrinkle falls back to the
+    spooled read path the handler was already taking."""
+    try:
+        if not sel.device_capable():
+            return None
+        fn = getattr(
+            handler.s3.object_layer, "device_scan_source", None
+        )
+        if fn is None:
+            return None
+        return fn(bucket, key)
+    except Exception:  # noqa: BLE001 - pushdown is best-effort
+        return None
 
 
 def handle_select(handler, bucket, key, info, body) -> None:
@@ -27,19 +74,39 @@ def handle_select(handler, bucket, key, info, body) -> None:
             e.code if e.code in _KNOWN else "InvalidRequestParameter",
             e.msg,
         ) from None
+    adm = getattr(handler.s3, "admission", None)
+    if adm is not None:
+        if not adm.try_enter_select():
+            adm.stats.shed_inc("select")
+            raise S3Error("OperationMaxedOut", "scan capacity reached")
+    try:
+        _run_select(handler, bucket, key, info, sel)
+    finally:
+        if adm is not None:
+            adm.leave_select()
+
+
+def _run_select(handler, bucket, key, info, sel) -> None:
     with tempfile.SpooledTemporaryFile(max_size=SPOOL_MEM) as spool, \
             tempfile.SpooledTemporaryFile(max_size=SPOOL_MEM) as out:
-        # full-object read through the erasure/SSE/compression stack
-        # SSE-C objects are selectable with their key (the reference
-        # routes select reads through getObjectNInfo, which decrypts)
-        handler.s3.object_layer.get_object(
-            bucket, key, spool, sse=handler._read_sse(info)
-        )
-        spool.seek(0)
+        src = _device_source(handler, bucket, key, info, sel)
         try:
-            # result frames spool too: a huge SELECT * result must not
-            # live in RAM (code-review r4 finding)
-            sel.evaluate(spool, info.size, out.write)
+            if src is not None:
+                # result frames spool either way: a huge SELECT *
+                # result must not live in RAM (code-review r4 finding)
+                sel.evaluate(
+                    None, info.size, out.write, device_source=src
+                )
+            else:
+                # full-object read through the erasure/SSE/compression
+                # stack.  SSE-C objects are selectable with their key
+                # (the reference routes select reads through
+                # getObjectNInfo, which decrypts)
+                handler.s3.object_layer.get_object(
+                    bucket, key, spool, sse=handler._read_sse(info)
+                )
+                spool.seek(0)
+                sel.evaluate(_spool_reader(spool), info.size, out.write)
         except SelectError as e:
             raise S3Error(
                 e.code if e.code in _KNOWN else "InvalidRequestParameter",

@@ -90,6 +90,8 @@ class _Ctx:
         self.breaker_log: "list[str]" = []
         # cross-step measurements (latency percentiles, counter marks)
         self.marks: "dict[str, float]" = {}
+        # (key, expr) -> row-engine oracle Records bytes, computed once
+        self.select_oracles: "dict[tuple, bytes]" = {}
 
     def confirm(self, key: str, body: bytes) -> None:
         self.objects[key] = [body]
@@ -299,6 +301,184 @@ def _step_assert_data_reads_flat(
             f"cache-hit flood touched the data plane: "
             f"{DATA_READ_API} calls moved {before:.0f} -> {now:.0f}"
         )
+
+
+# -- S3-Select verbs -------------------------------------------------------
+#
+# The select cells treat every node's SELECT response as a claim about
+# the object's bytes: the Records payload must be BIT-IDENTICAL to the
+# row engine run locally in the driver process over the payload the
+# client wrote.  Whatever engine a node picks (device screen, host
+# vector, row) and however degraded its disks are, the answer may not
+# drift.
+
+
+def csv_payload(rows: int, seed: int) -> bytes:
+    """Deterministic CSV table (same shape for driver and cluster)."""
+    lines = ["id,name,qty,price"]
+    for i in range(rows):
+        j = i + seed
+        lines.append(f"{i},item{j % 13},{j % 11},{(j % 7) * 0.75}")
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _select_xml(expr: str) -> bytes:
+    return (
+        "<SelectObjectContentRequest>"
+        f"<Expression>{expr.replace('<', '&lt;')}</Expression>"
+        "<ExpressionType>SQL</ExpressionType>"
+        "<InputSerialization><CSV><FileHeaderInfo>USE"
+        "</FileHeaderInfo></CSV></InputSerialization>"
+        "<OutputSerialization><CSV/></OutputSerialization>"
+        "</SelectObjectContentRequest>"
+    ).encode()
+
+
+def _select_records(stream: bytes) -> bytes:
+    from ..s3select.message import decode_all
+
+    return b"".join(
+        m["payload"]
+        for m in decode_all(stream)
+        if m["headers"].get(":event-type") == "Records"
+    )
+
+
+def _select_oracle(ctx: _Ctx, key: str, expr: str) -> bytes:
+    """Row-engine answer computed in the driver process (no cluster
+    involvement), cached per (key, expr)."""
+    cached = ctx.select_oracles.get((key, expr))
+    if cached is not None:
+        return cached
+    import io
+    import os
+
+    from ..s3select.engine import S3Select, SelectRequest
+
+    data = ctx.objects[key][0]
+    saved = os.environ.get("MINIO_TPU_SELECT")
+    os.environ["MINIO_TPU_SELECT"] = "row"
+    try:
+        out = bytearray()
+        sel = S3Select(SelectRequest.from_xml(_select_xml(expr)))
+        sel.evaluate(io.BytesIO(data), len(data), out.extend)
+    finally:
+        if saved is None:
+            os.environ.pop("MINIO_TPU_SELECT", None)
+        else:
+            os.environ["MINIO_TPU_SELECT"] = saved
+    oracle = _select_records(bytes(out))
+    ctx.select_oracles[(key, expr)] = oracle
+    return oracle
+
+
+def _select_once(ctx: _Ctx, node: int, key: str, expr: str):
+    return ctx.h.client(node).request(
+        "POST",
+        f"/{BUCKET}/{key}",
+        query={"select": "", "select-type": "2"},
+        body=_select_xml(expr),
+    )
+
+
+def _select_flood(
+    ctx: _Ctx, key: str, expr: str, count: int, threads: int
+) -> "list[float]":
+    """SELECT storm from every node; every reply must be 200 with a
+    Records payload bit-identical to the local row-engine oracle."""
+    oracle = _select_oracle(ctx, key, expr)
+    fails: list[str] = []
+    latencies: list[float] = []
+
+    import http.client as _hc
+
+    def run(worker: int) -> None:
+        for j in range(count):
+            node = (worker + j) % len(ctx.h.nodes)
+            if not ctx.h.nodes[node].alive():
+                continue
+            for attempt in (0, 1):
+                t0 = time.monotonic()
+                try:
+                    status, _, body = _select_once(ctx, node, key, expr)
+                except (OSError, _hc.HTTPException):
+                    if attempt:
+                        fails.append(f"n{node + 1}#{j}: transport")
+                    continue
+                if status != 200:
+                    fails.append(f"n{node + 1}#{j}: HTTP {status}")
+                elif _select_records(body) != oracle:
+                    fails.append(f"n{node + 1}#{j}: records diverged")
+                else:
+                    latencies.append(time.monotonic() - t0)
+                break
+
+    ts = [
+        threading.Thread(target=run, args=(w,), daemon=True)
+        for w in range(threads)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    if fails:
+        raise AssertionError(
+            f"select flood on {key}: {len(fails)} bad answers "
+            f"(first: {fails[0]})"
+        )
+    return latencies
+
+
+def _step_put_csv(
+    ctx: _Ctx, node: int, key: str, rows: int, seed: int
+) -> None:
+    status = _put(ctx, node, key, csv_payload(rows, seed))
+    if status != 200:
+        raise AssertionError(f"PUT {key} via n{node + 1}: HTTP {status}")
+
+
+def _step_select_flood(
+    ctx: _Ctx, key: str, expr: str, count: int, threads: int = 4
+) -> None:
+    _select_flood(ctx, key, expr, count, threads)
+
+
+def _step_timed_select_flood(
+    ctx: _Ctx, key: str, expr: str, count: int, threads: int, mark: str
+) -> None:
+    """select_flood + record the p99 latency under ``mark``."""
+    ctx.marks[mark] = _p99(_select_flood(ctx, key, expr, count, threads))
+
+
+def _step_select_churn(
+    ctx: _Ctx, key: str, expr: str, rounds: int, threads: int = 2
+) -> None:
+    """Background scan load: keep SELECTing until joined.  Transport
+    hiccups are tolerated; a wrong ANSWER is recorded and fails the
+    scenario at the end (bit-identity holds even for background load)."""
+    oracle = _select_oracle(ctx, key, expr)
+
+    def run(worker: int) -> None:
+        for r in range(rounds):
+            node = (worker + r) % len(ctx.h.nodes)
+            if not ctx.h.nodes[node].alive():
+                continue
+            try:
+                status, _, body = _select_once(ctx, node, key, expr)
+            except OSError:
+                time.sleep(0.1)
+                continue
+            if status == 200 and _select_records(body) != oracle:
+                ctx.errors.append(
+                    f"select churn n{node + 1}#{r}: records diverged"
+                )
+
+    for w in range(threads):
+        t = threading.Thread(
+            target=run, args=(w,), name="grid-select", daemon=True
+        )
+        t.start()
+        ctx.threads.append(t)
 
 
 def _step_make_bucket(ctx: _Ctx, node: int, name: str) -> None:
@@ -516,6 +696,10 @@ _VERBS = {
     "assert_p99_within": _step_assert_p99_within,
     "mark_data_reads": _step_mark_data_reads,
     "assert_data_reads_flat": _step_assert_data_reads_flat,
+    "put_csv": _step_put_csv,
+    "select_flood": _step_select_flood,
+    "timed_select_flood": _step_timed_select_flood,
+    "select_churn": _step_select_churn,
     "make_bucket": _step_make_bucket,
     "enable_replication": _step_enable_replication,
     "await_replication": _step_await_replication,

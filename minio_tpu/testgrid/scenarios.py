@@ -160,6 +160,33 @@ REPLICATION_LAG_CHURN = Scenario(
     ),
 )
 
+# Heavy SELECT mix: an analytics scan flood shares the cluster with
+# the hot-key GET path.  Every SELECT response — whatever engine the
+# node picks (device screen, host vector, row) — must be BIT-IDENTICAL
+# to the row engine run locally by the driver over the payload the
+# client wrote, including while one node's shard reads error (degraded
+# erasure reads feed the scan plane); and GET p99 under concurrent
+# scan load stays within 1.5x of the healthy baseline.
+_SELECT_EXPR = "SELECT s.id, s.name FROM S3Object s WHERE s.qty > 6"
+
+SELECT_HEAVY_MIX = Scenario(
+    name="select_heavy_mix",
+    title="select flood vs GET mix: bit-identical answers under faults",
+    steps=(
+        ("put_csv", 0, "table.csv", 4000, 17),
+        ("select_flood", "table.csv", _SELECT_EXPR, 3, 2),  # warm engines
+        ("timed_get_flood", "seed0", 20, 4, "healthy_p99"),
+        ("select_churn", "table.csv", _SELECT_EXPR, 10, 2),
+        ("timed_get_flood", "seed0", 20, 4, "mixed_p99"),
+        ("join",),
+        ("assert_p99_within", "mixed_p99", "healthy_p99", 1.5, 0.15),
+        ("fault", Fault(node=1, api="read_file_stream", error=True)),
+        ("select_flood", "table.csv", _SELECT_EXPR, 6, 3),
+        ("clear", 1),
+        ("select_flood", "table.csv", _SELECT_EXPR, 3, 2),
+    ),
+)
+
 GRID = (
     DEAD_REMOTE_DISKS,
     SLOW_REMOTE_DISKS,
@@ -169,6 +196,7 @@ GRID = (
     HOT_KEY_FLOOD,
     HOT_KEY_CACHE_FLOOD,
     REPLICATION_LAG_CHURN,
+    SELECT_HEAVY_MIX,
 )
 
 

@@ -1,0 +1,30 @@
+"""Fixture: eager S3-Select readback outside the drain seam (MTPU111).
+
+Linted under the rel_path ``minio_tpu/s3select/device.py`` so the
+select-drain scope applies.  Each offending line carries a
+``# VIOLATION: MTPU###`` marker; the test derives the expected
+(rule, line) set from these markers.
+"""
+
+import jax
+import numpy as np
+
+
+def _screen_spans(cand, blk):
+    counts = np.asarray(blk)  # VIOLATION: MTPU111
+    return counts
+
+
+def run_device(dev_arr, nbytes):
+    plane = jax.device_get(dev_arr)  # VIOLATION: MTPU111
+    return plane[:nbytes]
+
+
+def _filter_host_bytes(mat):
+    rows = np.array(mat)  # VIOLATION: MTPU111
+    return rows.tobytes()
+
+
+def as_device_plane(chunks, size):
+    # np.frombuffer on host bytes is exempt (not a D2H readback)
+    return np.frombuffer(chunks[0], dtype=np.uint8)[:size]

@@ -1,0 +1,30 @@
+"""Fixture: sanctioned S3-Select drain seams (no MTPU111 findings).
+
+Linted under the rel_path ``minio_tpu/s3select/device.py``: the same
+materialization calls are fine inside any function whose name contains
+"drain" — the result-drain seam through which candidate rows cross D2H.
+"""
+
+import jax
+import numpy as np
+
+
+def _drain_scalars(*vals):
+    return tuple(np.asarray(v).item() for v in vals)
+
+
+def _drain_array(dev):
+    return np.asarray(dev)
+
+
+def _drain_fallback_chunk(dev_arr, nbytes):
+    return jax.device_get(dev_arr)[:nbytes].tobytes()
+
+
+def drain_plane(dev_arr, nbytes):
+    return np.array(dev_arr[:nbytes]).tobytes()
+
+
+def _screen_spans(arr):
+    # host-side byte parsing is fine: frombuffer is not a readback
+    return np.frombuffer(arr, dtype=np.uint8)

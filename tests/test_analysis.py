@@ -427,6 +427,49 @@ def test_mtpu110_in_rule_catalog():
     assert "MTPU110" in RULES
 
 
+# -- MTPU111: S3-Select D2H only through the result-drain seam ----------
+#
+# Scope is the single file s3select/device.py (exact match, not a
+# prefix), so the fixtures are linted AS that file; the seam is any
+# enclosing function whose name contains "drain".
+
+
+def test_bad_mtpu111_exact_findings_under_select_scope():
+    expected = _expected_markers("bad_mtpu111.py")
+    assert expected, "bad_mtpu111.py declares no VIOLATION markers"
+    got = {
+        (f.rule, f.line)
+        for f in _lint_fixture(
+            "bad_mtpu111.py", rel_path="minio_tpu/s3select/device.py"
+        )
+    }
+    assert got == expected
+
+
+def test_good_mtpu111_clean_under_select_scope():
+    found = _lint_fixture(
+        "good_mtpu111.py", rel_path="minio_tpu/s3select/device.py"
+    )
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_mtpu111_silent_outside_select_scope():
+    """The same source under another s3select module raises nothing —
+    the drain seam is a device.py contract, not a package-wide one."""
+    for rel in (
+        "minio_tpu/s3select/vector.py",
+        "minio_tpu/server/select.py",
+    ):
+        found = _lint_fixture("bad_mtpu111.py", rel_path=rel)
+        assert not any(f.rule == "MTPU111" for f in found), "\n".join(
+            f.render() for f in found
+        )
+
+
+def test_mtpu111_in_rule_catalog():
+    assert "MTPU111" in RULES
+
+
 def test_noqa_suppresses_matching_rule():
     found = _lint_fixture("noqa_suppressed.py")
     assert found == [], "\n".join(f.render() for f in found)

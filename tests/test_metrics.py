@@ -510,6 +510,62 @@ def test_read_cache_families_zero_filled_when_off():
     assert all(v == 0.0 for v in kinds.values())
 
 
+def test_select_families_zero_filled():
+    """miniotpu_select_* render with a stable, zero-filled label set
+    (every engine and fallback reason) before any scan has run."""
+    from minio_tpu.s3select.device import STATS, SelectStats
+
+    saved = STATS.snapshot()
+    STATS.reset()
+    try:
+        families = parse_exposition(Metrics().render().decode())
+        fam = get_family(families, "miniotpu_select_requests_total")
+        assert fam["type"] == "counter"
+        engines = {lab["engine"]: v for _n, lab, v in fam["samples"]}
+        assert set(engines) == set(SelectStats.ENGINES)
+        assert all(v == 0.0 for v in engines.values())
+        fam = get_family(families, "miniotpu_select_fallback_total")
+        reasons = {lab["reason"]: v for _n, lab, v in fam["samples"]}
+        assert set(reasons) == set(SelectStats.REASONS)
+        assert all(v == 0.0 for v in reasons.values())
+        for name in (
+            "miniotpu_select_scanned_bytes_total",
+            "miniotpu_select_returned_bytes_total",
+            "miniotpu_select_device_seconds_total",
+        ):
+            fam = get_family(families, name)
+            assert fam["type"] == "counter"
+            assert fam["samples"][0][2] == 0.0, name
+    finally:
+        # restore cross-test counters (STATS is a process singleton)
+        STATS.reset()
+        for e, n in saved["requests"].items():
+            for _ in range(n):
+                STATS.request(e)
+        for r, n in saved["fallbacks"].items():
+            for _ in range(n):
+                STATS.fallback(r)
+        STATS.io(saved["scanned_bytes"], saved["returned_bytes"])
+        STATS.device_time(saved["device_seconds"])
+
+
+def test_select_families_reflect_live_counters():
+    from minio_tpu.s3select.device import STATS
+
+    STATS.request("device")
+    STATS.fallback("hazard")
+    STATS.io(1024, 64)
+    families = parse_exposition(Metrics().render().decode())
+    fam = get_family(families, "miniotpu_select_requests_total")
+    engines = {lab["engine"]: v for _n, lab, v in fam["samples"]}
+    assert engines["device"] >= 1.0
+    fam = get_family(families, "miniotpu_select_fallback_total")
+    reasons = {lab["reason"]: v for _n, lab, v in fam["samples"]}
+    assert reasons["hazard"] >= 1.0
+    fam = get_family(families, "miniotpu_select_scanned_bytes_total")
+    assert fam["samples"][0][2] >= 1024.0
+
+
 def test_read_cache_families_reflect_live_counters(monkeypatch):
     from minio_tpu import cache as rcache
 
@@ -556,6 +612,8 @@ def test_live_server_plane_families(server, client):
     fam = get_family(families, "miniotpu_server_stage_queue_depth")
     stages = {lab["stage"] for _n, lab, _v in fam["samples"]}
     assert {"parse", "handler", "codec"} <= stages, stages
+    from minio_tpu.server.admission import SHED_REASONS
+
     fam = get_family(families, "miniotpu_server_shed_total")
     reasons = {lab["reason"] for _n, lab, _v in fam["samples"]}
-    assert reasons == {"queue", "quota", "tenant"}
+    assert reasons == set(SHED_REASONS)

@@ -1,0 +1,422 @@
+"""TPU-pushdown S3 Select (minio_tpu/s3select/device.py): dispatch
+modes, screen compilation/eligibility, the fallback ladder, device
+vs row-engine bit-identity (streamed and device-resident), the cache
+tier -> scan-plane seam, and the select admission class.
+
+The device engine is a conservative pre-filter: every test here holds
+it to byte-for-byte equality with the row-engine oracle
+(``MINIO_TPU_SELECT=row``), which is the pre-device behavior.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from minio_tpu import cache as rcache
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3select import device, vector
+from minio_tpu.s3select.engine import S3Select, SelectRequest
+from minio_tpu.s3select.message import decode_all
+from minio_tpu.server.admission import AdmissionController, PlaneStats
+from minio_tpu.server.http import S3Server
+from minio_tpu.storage.xl import XLStorage
+
+from s3client import S3Client
+
+BLOCK = 4096
+
+
+# -- harness -------------------------------------------------------------
+
+
+@pytest.fixture
+def mode_env():
+    """Set MINIO_TPU_SELECT for the test, restore after."""
+    saved = os.environ.get("MINIO_TPU_SELECT")
+
+    def set_mode(mode):
+        os.environ["MINIO_TPU_SELECT"] = mode
+
+    yield set_mode
+    if saved is None:
+        os.environ.pop("MINIO_TPU_SELECT", None)
+    else:
+        os.environ["MINIO_TPU_SELECT"] = saved
+
+
+@pytest.fixture
+def cache_env():
+    """Enable the device-tier read cache, restore + reset after."""
+
+    def enable(mode="device"):
+        os.environ["MINIO_TPU_READ_CACHE"] = mode
+        rcache.reset_read_cache()
+
+    saved = os.environ.get("MINIO_TPU_READ_CACHE")
+    yield enable
+    if saved is None:
+        os.environ.pop("MINIO_TPU_READ_CACHE", None)
+    else:
+        os.environ["MINIO_TPU_READ_CACHE"] = saved
+    rcache.reset_read_cache()
+
+
+@pytest.fixture
+def layer(tmp_path):
+    disks = [XLStorage(str(tmp_path / f"disk{i}")) for i in range(6)]
+    ol = ErasureObjects(disks, block_size=BLOCK)
+    ol.make_bucket("bucket")
+    return ol, disks
+
+
+def _body(expr, header="USE"):
+    return (
+        "<SelectObjectContentRequest>"
+        f"<Expression>{expr.replace('<', '&lt;').replace('>', '&gt;')}"
+        "</Expression><ExpressionType>SQL</ExpressionType>"
+        f"<InputSerialization><CSV><FileHeaderInfo>{header}"
+        "</FileHeaderInfo></CSV></InputSerialization>"
+        "<OutputSerialization><CSV/></OutputSerialization>"
+        "</SelectObjectContentRequest>"
+    ).encode()
+
+
+def _records(frames):
+    return b"".join(
+        m["payload"]
+        for m in decode_all(frames)
+        if m["headers"].get(":event-type") == "Records"
+    )
+
+
+def _run(expr, data, mode, header="USE", resident=False):
+    """Evaluate under a pinned MINIO_TPU_SELECT mode; returns the
+    Records payload, or an ("ERR", code) tuple so error behavior is
+    compared across engines too."""
+    from minio_tpu.s3select import SelectError
+
+    saved = os.environ.get("MINIO_TPU_SELECT")
+    os.environ["MINIO_TPU_SELECT"] = mode
+    try:
+        req = SelectRequest.from_xml(_body(expr, header))
+        sel = S3Select(req)
+        frames = bytearray()
+        try:
+            if resident:
+                src = device.as_device_plane(
+                    [np.frombuffer(data, dtype=np.uint8)], len(data)
+                )
+                sel.evaluate(None, len(data), frames.extend,
+                             device_source=src)
+            else:
+                sel.evaluate(io.BytesIO(data), len(data), frames.extend)
+        except SelectError as e:
+            return ("ERR", e.code)
+        return _records(bytes(frames))
+    finally:
+        if saved is None:
+            os.environ.pop("MINIO_TPU_SELECT", None)
+        else:
+            os.environ["MINIO_TPU_SELECT"] = saved
+
+
+def _clean_csv(nrows=600):
+    rows = ["id,name,qty,price"]
+    for i in range(nrows):
+        rows.append(f"{i},item{i % 13},{i % 11},{(i % 7) * 0.75}")
+    return ("\n".join(rows) + "\n").encode()
+
+
+NASTY_CSV = (
+    b"id,name,qty,price\n"
+    b'1,"say ""hi""",5,1.5\n'
+    b"2,plain,6,2.5\n"
+    b"\n"
+    b"3, spaced ,7,1e2\n"
+    b"4,neg,-3,-0.5\n"
+    b"5,big,1000000,2\n"
+    b"6,tail,9,0.25\n"
+)
+
+
+# -- mode knob -----------------------------------------------------------
+
+
+def test_select_mode_parsing(mode_env):
+    for raw, want in (
+        ("device", "device"), ("host", "host"), ("row", "row"),
+        ("auto", "auto"), (" DEVICE ", "device"), ("bogus", "auto"),
+    ):
+        mode_env(raw)
+        assert device.select_mode() == want
+    os.environ.pop("MINIO_TPU_SELECT", None)
+    assert device.select_mode() == "auto"
+
+
+def test_mode_dispatch_counts_engines(mode_env):
+    data = _clean_csv(64)
+    expr = "SELECT s.name FROM S3Object s WHERE s.qty > 8"
+    for mode, engine_key in (
+        ("row", "row"), ("host", "host"), ("device", "device"),
+    ):
+        before = device.STATS.snapshot()["requests"][engine_key]
+        _run(expr, data, mode)
+        after = device.STATS.snapshot()["requests"][engine_key]
+        assert after == before + 1, mode
+
+
+# -- eligibility / screen compilation ------------------------------------
+
+
+def test_device_eligible_shapes(mode_env):
+    mode_env("auto")
+
+    def cap(expr, header="USE"):
+        req = SelectRequest.from_xml(_body(expr, header))
+        return S3Select(req).device_capable()
+
+    assert cap("SELECT * FROM S3Object s WHERE s.qty > 5")
+    assert cap("SELECT s.name FROM S3Object s WHERE s.name = 'x'")
+    # no WHERE: nothing to screen, the host engines own it
+    assert not cap("SELECT * FROM S3Object s")
+    # positional column without a header resolves at compile time
+    assert cap("SELECT * FROM S3Object WHERE _2 > 6", header="NONE")
+    mode_env("row")
+    assert not cap("SELECT * FROM S3Object s WHERE s.qty > 5")
+
+
+def test_unsupported_where_falls_back_silently(mode_env):
+    """LIKE has no conservative screen: mode=device must still answer,
+    via the host engines, byte-identically."""
+    data = _clean_csv(200)
+    expr = "SELECT * FROM S3Object s WHERE s.name LIKE 'item1%'"
+    oracle = _run(expr, data, "row")
+    before = device.STATS.snapshot()["fallbacks"]["unsupported"]
+    assert _run(expr, data, "device") == oracle
+    after = device.STATS.snapshot()["fallbacks"]["unsupported"]
+    assert after >= before + 1
+
+
+# -- bit-identity: device (stream + resident) vs the row oracle ----------
+
+DEVICE_EXPRS = [
+    "SELECT * FROM S3Object s WHERE s.qty > 5",
+    "SELECT s.name, s.price FROM S3Object s WHERE s.qty = 3",
+    "SELECT COUNT(*) FROM S3Object s WHERE s.qty < 4",
+    "SELECT s.id FROM S3Object s WHERE s.id >= 550",
+    "SELECT * FROM S3Object s WHERE s.name = 'item7'",
+    "SELECT SUM(s.qty), AVG(s.price) FROM S3Object s WHERE s.qty <= 2",
+]
+
+
+@pytest.mark.parametrize("expr", DEVICE_EXPRS)
+def test_device_bit_identical_to_row_engine(expr):
+    data = _clean_csv()
+    oracle = _run(expr, data, "row")
+    assert _run(expr, data, "host") == oracle, "host vector"
+    assert _run(expr, data, "device") == oracle, "device stream"
+    assert _run(expr, data, "device", resident=True) == oracle, (
+        "device resident"
+    )
+
+
+def test_hazard_rows_fall_back_bit_identical():
+    """Quoted fields trip the hazard scalar: the chunk is re-run on
+    host, and content still matches the oracle exactly."""
+    expr = "SELECT s.name FROM S3Object s WHERE s.qty > 4"
+    oracle = _run(expr, NASTY_CSV, "row")
+    before = device.STATS.snapshot()["fallbacks"]["hazard"]
+    assert _run(expr, NASTY_CSV, "device") == oracle
+    assert _run(expr, NASTY_CSV, "device", resident=True) == oracle
+    after = device.STATS.snapshot()["fallbacks"]["hazard"]
+    assert after >= before + 1
+
+
+def test_ratio_fallback_bit_identical():
+    """A screen that passes >25% of a big chunk is not worth the
+    gather: the chunk falls back, content identical."""
+    rows = ["q"] + ["9"] * 5000
+    data = ("\n".join(rows) + "\n").encode()
+    expr = "SELECT COUNT(*) FROM S3Object s WHERE s.q > 1"
+    oracle = _run(expr, data, "row")
+    before = device.STATS.snapshot()["fallbacks"]["ratio"]
+    assert _run(expr, data, "device") == oracle
+    after = device.STATS.snapshot()["fallbacks"]["ratio"]
+    assert after >= before + 1
+    assert oracle.strip() == b"5000"
+
+
+def test_errors_match_across_engines():
+    """A query that raises (SUM over a string column) must raise the
+    same error from every engine."""
+    expr = "SELECT SUM(s.name) FROM S3Object s WHERE s.qty > 5"
+    data = _clean_csv(64)
+    oracle = _run(expr, data, "row")
+    assert isinstance(oracle, tuple) and oracle[0] == "ERR"
+    assert _run(expr, data, "device") == oracle
+    assert _run(expr, data, "device", resident=True) == oracle
+
+
+def test_resident_plane_without_trailing_newline():
+    """as_device_plane must newline-terminate un-terminated objects
+    without inventing a blank row on terminated ones."""
+    expr = "SELECT * FROM S3Object s WHERE s.qty > 5"
+    base = _clean_csv(100)
+    for data in (base, base[:-1]):
+        oracle = _run(expr, data, "row")
+        assert _run(expr, data, "device", resident=True) == oracle
+
+
+def test_stats_io_counters_move():
+    data = _clean_csv(64)
+    before = device.STATS.snapshot()
+    _run("SELECT * FROM S3Object s WHERE s.qty > 8", data, "device")
+    after = device.STATS.snapshot()
+    assert after["scanned_bytes"] - before["scanned_bytes"] == len(data)
+    assert after["returned_bytes"] > before["returned_bytes"]
+    assert after["device_seconds"] >= before["device_seconds"]
+
+
+# -- cache tier -> device scan plane -------------------------------------
+
+
+def test_cache_served_scan_zero_data_reads(cache_env, layer, monkeypatch):
+    """A scan over an object whose groups sit in the device cache tier
+    reads ZERO shard bytes from disk — the plane is assembled from the
+    cached group buffers — and still matches the row oracle."""
+    ol, _ = layer
+    cache_env("device")
+    data = _clean_csv(400)
+    ol.put_object("bucket", "t.csv", io.BytesIO(data), len(data))
+    buf = io.BytesIO()
+    ol.get_object("bucket", "t.csv", buf)  # warm the device tier
+    assert buf.getvalue() == data
+
+    src = ol.device_scan_source("bucket", "t.csv")
+    assert src is not None, "device tier did not cover the object"
+    plane, nbytes = src
+    assert nbytes >= len(data)
+
+    reads = []
+    orig = XLStorage.read_file_stream
+
+    def counting(self, volume, path):
+        reads.append((volume, path))
+        return orig(self, volume, path)
+
+    monkeypatch.setattr(XLStorage, "read_file_stream", counting)
+    expr = "SELECT s.name FROM S3Object s WHERE s.qty > 8"
+    oracle = _run(expr, data, "row")
+    req = SelectRequest.from_xml(_body(expr))
+    sel = S3Select(req)
+    os.environ["MINIO_TPU_SELECT"] = "auto"
+    frames = bytearray()
+    try:
+        assert sel.device_capable()
+        sel.evaluate(None, len(data), frames.extend, device_source=src)
+    finally:
+        os.environ.pop("MINIO_TPU_SELECT", None)
+    assert _records(bytes(frames)) == oracle
+    assert reads == [], f"scan touched disk: {reads}"
+
+
+def test_scan_source_absent_without_device_tier(cache_env, layer):
+    """host-tier cache (or cold object) yields no device scan source;
+    the server path then spools through the normal read."""
+    ol, _ = layer
+    cache_env("host")
+    data = _clean_csv(50)
+    ol.put_object("bucket", "h.csv", io.BytesIO(data), len(data))
+    io_sink = io.BytesIO()
+    ol.get_object("bucket", "h.csv", io_sink)
+    assert ol.device_scan_source("bucket", "h.csv") is None
+
+
+# -- admission: scans as a second traffic class --------------------------
+
+
+def test_select_admission_cap(monkeypatch):
+    adm = AdmissionController(None, PlaneStats())
+    monkeypatch.setenv("MINIO_TPU_SELECT_MAX_INFLIGHT", "1")
+    assert adm.try_enter_select()
+    assert not adm.try_enter_select()
+    adm.leave_select()
+    assert adm.try_enter_select()
+    adm.leave_select()
+    assert adm.select_inflight() == 0
+    # 0 = unlimited
+    monkeypatch.setenv("MINIO_TPU_SELECT_MAX_INFLIGHT", "0")
+    for _ in range(4):
+        assert adm.try_enter_select()
+
+
+def test_select_shed_reason_zero_filled():
+    assert PlaneStats().snapshot()["shed"].get("select") == 0
+
+
+def test_select_over_http_sheds_at_cap(monkeypatch, tmp_path):
+    """With the scan slot held, SELECT sheds 503 (reason=select);
+    after release the same request succeeds."""
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=BLOCK, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    try:
+        client = S3Client(srv.endpoint)
+        client.make_bucket("selb")
+        client.put_object("selb", "d.csv", _clean_csv(16))
+        body = _body("SELECT * FROM S3Object s WHERE s.qty > 5")
+        monkeypatch.setenv("MINIO_TPU_SELECT_MAX_INFLIGHT", "1")
+        assert srv.admission.try_enter_select()  # occupy the only slot
+        try:
+            r = client.request(
+                "POST", "/selb/d.csv",
+                query={"select": "", "select-type": "2"}, body=body,
+            )
+            assert r.status == 503
+            assert srv.plane_stats.snapshot()["shed"]["select"] >= 1
+        finally:
+            srv.admission.leave_select()
+        r = client.request(
+            "POST", "/selb/d.csv",
+            query={"select": "", "select-type": "2"}, body=body,
+        )
+        assert r.status == 200
+        assert _records(r.body)
+    finally:
+        srv.shutdown()
+
+
+def test_spooled_source_every_engine():
+    """The server spools select sources through SpooledTemporaryFile,
+    which lacks the io ABC probes (``readable()``) before Python
+    3.11 — the handler's reader shim must keep every engine working
+    over a rolled-over spool (caught live: the row engine's
+    TextIOWrapper 500'd on 3.10)."""
+    import tempfile
+
+    from minio_tpu.server.select import _spool_reader
+
+    data = _clean_csv()
+    expr = "SELECT s.id, s.name FROM S3Object s WHERE s.qty > 6"
+    want = _run(expr, data, "row")
+    assert want
+    for mode in ("row", "host", "device", "auto"):
+        saved = os.environ.get("MINIO_TPU_SELECT")
+        os.environ["MINIO_TPU_SELECT"] = mode
+        try:
+            with tempfile.SpooledTemporaryFile(max_size=64) as spool:
+                spool.write(data)  # far past max_size: disk-backed
+                spool.seek(0)
+                req = SelectRequest.from_xml(_body(expr))
+                frames = bytearray()
+                S3Select(req).evaluate(
+                    _spool_reader(spool), len(data), frames.extend
+                )
+            assert _records(bytes(frames)) == want, mode
+        finally:
+            if saved is None:
+                os.environ.pop("MINIO_TPU_SELECT", None)
+            else:
+                os.environ["MINIO_TPU_SELECT"] = saved
