@@ -423,7 +423,7 @@ def run() -> "list[Finding]":
 
     with enable_x64():
         u64 = jnp.uint64
-        wpb = select_step.BLOCK_BYTES // 8  # words per popcount reshape
+        wpb = select_step.POP_WORDS  # words per popcount block
 
         covers("select_step", "screen_chunk")
         c = ctx(select_step.screen_chunk, "minio_tpu/ops/select_step.py")
@@ -446,7 +446,9 @@ def run() -> "list[Finding]":
                         )
                         c.shape(cand, (n // 8,), "candidate flag words")
                         c.dtype(cand, "uint64", "candidate flag words")
-                        c.shape(blk, (n // 64,), "block popcounts")
+                        c.shape(
+                            blk, (n // (8 * wpb),), "block popcounts"
+                        )
                         c.dtype(blk, "int32", "block popcounts")
                         c.shape(nrows, (), "row count")
                         c.dtype(nrows, "int32", "row count")
@@ -465,7 +467,7 @@ def run() -> "list[Finding]":
                 try:
                     pos = select_step.extract_positions.eval_shape(
                         S((n // 8,), u64),
-                        S((n // 64,), jnp.int32),
+                        S((n // (8 * wpb),), jnp.int32),
                         cap=cap,
                     )
                     c.shape(pos, (cap,), "candidate byte positions")
@@ -521,9 +523,12 @@ def run() -> "list[Finding]":
                 except Exception as e:
                     c.fail(e)
 
-        # sanity: the popcount reshape granularity the contracts assume
-        # (8 words) matches the module's padding contract
+        # sanity: the padding granularity must be whole popcount
+        # blocks, or screen_chunk's reshape would fail on a padded
+        # plane (512 bytes / (8 words * 8 bytes) today)
         assert select_step.BLOCK_BYTES % (wpb * 8) == 0
+        assert all(n % select_step.BLOCK_BYTES == 0
+                   for n in _SELECT_PLANES)
 
     # ---- rs_pallas.py ---------------------------------------------------
 

