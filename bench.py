@@ -207,19 +207,45 @@ def bench_cpu_baseline() -> dict:
 
 
 def bench_codec_micro() -> dict:
-    """Fused vs split CPU encode+digest at fixed geometry (--codec-micro).
+    """Codec microbench (--codec-micro): CPU-native fused-vs-split plus
+    the round-14 one-kernel device variant sweep (BENCH_r14 schema).
 
-    Isolates the single-pass kernel win from the ±30% e2e noise on this
-    host: one (64, 8, 128 KiB) batch - 64 MiB of data, EC 8+4 - encoded
-    both ways on the bare CpuBackend.  "split" is the pre-fusion shape
-    kept callable as ``encode_split`` (per-stripe native matmul
-    round-trips + full-batch concatenate + separate digest pass);
-    "fused" is the production ``encode`` (one native call, one memory
-    pass per byte).  Outputs are asserted bit-identical before timing.
+    Section "native" (round 7, unchanged): one (64, 8, 128 KiB) batch -
+    64 MiB of data, EC 8+4 - encoded both ways on the bare CpuBackend.
+    "split" is the pre-fusion shape kept callable as ``encode_split``;
+    "fused" is the production ``encode``.
+
+    Section "kernel_variants" (round 14): the one-kernel codec
+    (MINIO_TPU_CODEC_KERNEL=fused1) against the legacy pass structure,
+    kernel-isolated at the codec_step seam, both directions:
+
+    * encode side: legacy three launches (encode+digest, group_flags,
+      pack_nonzero_groups) vs ``encode_words_fused1`` - portable XLA
+      formulation timed, Pallas interpreter (SWAR and MXU formulations)
+      gated for bit-identity but reported without throughput claims
+      (the interpreter is a correctness mode, not a fast path);
+    * reconstruct side: verify_hashes_words -> reconstruct_words_batch
+      vs ``verify_and_reconstruct_words``.
+
+    Every variant is asserted bit-identical against legacy BEFORE any
+    timing (hard gate).  Section "pass_accounting" drives the real
+    TpuBackend seam per mode and records KERNEL_STATS device_passes +
+    per-plane D2H bytes: fused1 PUT must be exactly one launch (legacy
+    three) with digest-only eager readback.
     """
     import os
 
-    from minio_tpu.codec.backend import CpuBackend
+    import jax
+    import jax.numpy as jnp
+
+    from minio_tpu.codec import compress
+    from minio_tpu.codec.backend import (
+        CpuBackend,
+        TpuBackend,
+        reset_backend,
+    )
+    from minio_tpu.codec.telemetry import KERNEL_STATS
+    from minio_tpu.ops import codec_step, rs_pallas
     from minio_tpu.utils import native
 
     rng = np.random.default_rng(0)
@@ -245,7 +271,7 @@ def bench_codec_micro() -> dict:
     t_fused, sp_f = _time(lambda: be.encode(data, m))
     t_split, sp_s = _time(lambda: be.encode_split(data, m))
     gib = data.nbytes / 2**30
-    return {
+    native_section = {
         "ec": f"{k}+{m}",
         "batch": B,
         "shard_len": shard_len,
@@ -257,6 +283,177 @@ def bench_codec_micro() -> dict:
         "native_threads": native.default_threads(),
         "host_cpus": os.cpu_count(),
         "avx2": native.has_avx2(),
+    }
+
+    # -- round 14: one-kernel codec variant sweep -----------------------
+    # Geometry is Pallas-eligible (w a multiple of rs_pallas._TW) so the
+    # interpreter variants run the SAME tile program the TPU would.
+    kb, kk, km = 8, EC_K, EC_M
+    kL = 4 * rs_pallas._TW  # 16 KiB shards -> w = _TW words
+    G = compress.PARITY_GROUP_WORDS
+    n = kk + km
+    kdata = rng.integers(0, 256, (kb, kk, kL), dtype=np.uint8)
+    kdata[1] = 0  # one all-zero stripe: the pack leg must matter
+    kwords = codec_step.host_bytes_to_words(kdata)
+    kgib = kdata.nbytes / 2**30
+
+    def _block(x):
+        return jax.block_until_ready(x)
+
+    def enc_legacy(w_):
+        p, d = codec_step.encode_and_hash_words(w_, km, kL)
+        f = codec_step.group_flags(p, G)
+        f2, pk = codec_step.pack_nonzero_groups(p, G)
+        return _block((p, d, f, f2, pk))
+
+    def enc_fused(w_, formulation="swar", pallas=False):
+        return _block(
+            codec_step.encode_words_fused1(
+                w_, km, kL, G, formulation, pallas, pallas
+            )
+        )
+
+    dw = jnp.asarray(kwords)
+    lp, ld, lf, lf2, lpk = enc_legacy(dw)
+    enc_out = {"portable": enc_fused(jnp.asarray(kwords))}
+    for form in ("swar", "mxu"):
+        enc_out[f"interpret_{form}"] = enc_fused(
+            jnp.asarray(kwords), form, True
+        )
+    for name, (p, d, f, pk) in enc_out.items():
+        assert np.array_equal(np.asarray(p), np.asarray(lp)), name
+        assert np.array_equal(np.asarray(d), np.asarray(ld)), name
+        assert np.array_equal(np.asarray(f), np.asarray(lf2)), name
+        assert np.array_equal(np.asarray(pk), np.asarray(lpk)), name
+
+    # both sides pay the same fresh H2D per rep: the fused entry donates
+    # its input, so a parked buffer cannot be re-fed on real hardware
+    t_leg, sp_leg = _time(lambda: enc_legacy(jnp.asarray(kwords)))
+    t_f1, sp_f1 = _time(lambda: enc_fused(jnp.asarray(kwords)))
+
+    # reconstruct side: drop m shards, no bitrot (the verify cost is in
+    # hashing every present row either way)
+    kshards = np.concatenate(
+        [kwords, np.asarray(lp)], axis=1
+    )
+    present = (False,) * km + (True,) * (n - km)
+    digs = jnp.asarray(ld)
+    dsh = jnp.asarray(kshards)
+
+    def rec_legacy():
+        ok = codec_step.verify_hashes_words(dsh, digs, kL)
+        dwords = codec_step.reconstruct_words_batch(dsh, present, kk, km)
+        return _block((ok, dwords))
+
+    def rec_fused(formulation="swar", pallas=False):
+        return _block(
+            codec_step.verify_and_reconstruct_words(
+                dsh, digs, present, kk, km, kL, formulation, pallas, pallas
+            )
+        )
+
+    lok, ldw = rec_legacy()
+    lok = np.asarray(lok) & np.asarray(present)
+    rec_out = {"portable": rec_fused()}
+    for form in ("swar", "mxu"):
+        rec_out[f"interpret_{form}"] = rec_fused(form, True)
+    for name, (rdw, rok) in rec_out.items():
+        assert np.array_equal(np.asarray(rok), lok), name
+        assert np.array_equal(np.asarray(rdw), np.asarray(ldw)), name
+
+    t_rleg, sp_rleg = _time(rec_legacy)
+    t_rf1, sp_rf1 = _time(lambda: rec_fused())
+
+    variants = {
+        "ec": f"{kk}+{km}",
+        "batch": kb,
+        "shard_len": kL,
+        "data_mib": round(kdata.nbytes / 2**20, 2),
+        "group_words": G,
+        "bit_identical_all_variants": True,  # asserted above, hard gate
+        "encode": {
+            "legacy3_gibps": round(kgib / t_leg, 3),
+            "fused1_gibps": round(kgib / t_f1, 3),
+            "speedup": round(t_leg / t_f1, 2),
+            "rel_spread": round(max(sp_leg, sp_f1), 3),
+        },
+        "reconstruct": {
+            "legacy2_gibps": round(kgib / t_rleg, 3),
+            "fused1_gibps": round(kgib / t_rf1, 3),
+            "speedup": round(t_rleg / t_rf1, 2),
+            "rel_spread": round(max(sp_rleg, sp_rf1), 3),
+        },
+        "interpret_variants_checked": sorted(
+            name for name in enc_out if name.startswith("interpret")
+        ),
+    }
+
+    # -- pass/D2H accounting through the real backend seam --------------
+    saved = {
+        key: os.environ.get(key)
+        for key in ("MINIO_TPU_CODEC_KERNEL", "MINIO_MESH",
+                    "MINIO_TPU_DEVICE_COMPRESS")
+    }
+    accounting = {}
+    try:
+        os.environ["MINIO_MESH"] = "0"
+        os.environ["MINIO_TPU_DEVICE_COMPRESS"] = "on"
+        for mode in ("legacy", "fused1"):
+            os.environ["MINIO_TPU_CODEC_KERNEL"] = mode
+            reset_backend()
+            tb = TpuBackend()
+            KERNEL_STATS.reset()
+            dig, ref = tb.encode_digest_end(
+                tb.encode_digest_begin(kdata.copy(), km)
+            )
+            pre = dict(KERNEL_STATS.snapshot()["device_passes"])
+            planes_pre = {
+                d_["plane"]: d_["bytes"]
+                for d_ in KERNEL_STATS.snapshot()["d2h"]
+            }
+            par = ref.drain()
+            ref.release()
+            post = dict(KERNEL_STATS.snapshot()["device_passes"])
+            assert np.array_equal(par, be.encode(kdata, km)[0]), mode
+            KERNEL_STATS.reset()
+            shards_h = np.concatenate(
+                [kdata, codec_step.host_words_to_bytes(np.asarray(lp))],
+                axis=1,
+            )
+            got, ok = tb.reconstruct_and_verify(
+                shards_h, np.asarray(ld), (True,) * n, kk, km
+            )
+            assert np.array_equal(got, kdata), mode
+            rv = dict(KERNEL_STATS.snapshot()["device_passes"])
+            accounting[mode] = {
+                "put_passes": pre,
+                "put_passes_after_drain": post,
+                "put_total_launches": sum(post.values()),
+                "get_passes": rv,
+                "get_total_launches": sum(rv.values()),
+                "d2h_bytes_before_drain": planes_pre,
+                "digest_only_before_drain":
+                    planes_pre.get("parity", 0) == 0,
+            }
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        reset_backend()
+    assert accounting["fused1"]["put_total_launches"] == 1
+    assert accounting["fused1"]["put_passes_after_drain"] == \
+        accounting["fused1"]["put_passes"]
+    assert accounting["legacy"]["put_total_launches"] >= 3
+    assert accounting["fused1"]["get_total_launches"] == 1
+
+    return {
+        "metric": "codec micro (native fused-vs-split + one-kernel "
+        "variant sweep, bit-identity gated)",
+        "native": native_section,
+        "kernel_variants": variants,
+        "pass_accounting": accounting,
     }
 
 

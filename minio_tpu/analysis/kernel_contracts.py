@@ -393,6 +393,116 @@ def run() -> "list[Finding]":
             except Exception as e:
                 c.fail(e)
 
+    # ---- codec_step.py: one-kernel codec (fused1) -----------------------
+    #
+    # The fused1 entries subsume three legacy passes (encode+digest,
+    # group_flags, pack_nonzero_groups) resp. two (verify, reconstruct).
+    # Portable formulation is checked over CONFIG_GRID; the Pallas path
+    # over FUSED_GRID in interpret mode, both formulations, so contract
+    # coverage matches everything the dispatcher can launch.
+
+    covers("codec_step", "encode_words_fused1")
+    c = ctx(codec_step.encode_words_fused1, "minio_tpu/ops/codec_step.py")
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        for group in (0, _GROUP):
+            g = w // group if group else 0
+            c.config = cfg_str(k, m, L) + f" [portable, group={group}]"
+            try:
+                parity, digests, flags, packed = (
+                    codec_step.encode_words_fused1.eval_shape(
+                        S((_BATCH, k, w), u32), m, L, group
+                    )
+                )
+                c.shape(parity, (_BATCH, m, w), "fused1 parity")
+                c.dtype(parity, "uint32", "fused1 parity")
+                c.shape(digests, (_BATCH, n, 8), "fused1 digests")
+                c.dtype(digests, "uint32", "fused1 digests")
+                c.shape(flags, (_BATCH, m, g), "fused1 flags")
+                c.dtype(flags, "bool", "fused1 flags")
+                c.shape(packed, (_BATCH, m, w), "fused1 packed")
+                c.dtype(packed, "uint32", "fused1 packed")
+            except Exception as e:
+                c.fail(e)
+    for k, m, L in FUSED_GRID:
+        w, n = L // 4, k + m
+        group = 256  # compress.PARITY_GROUP_WORDS, the production granule
+        for formulation in ("swar", "mxu"):
+            c.config = cfg_str(k, m, L) + f" [pallas, {formulation}]"
+            try:
+                parity, digests, flags, packed = (
+                    codec_step.encode_words_fused1.eval_shape(
+                        S((_BATCH, k, w), u32), m, L, group,
+                        formulation, True, True,
+                    )
+                )
+                c.shape(parity, (_BATCH, m, w), "fused1 parity")
+                c.dtype(parity, "uint32", "fused1 parity")
+                c.shape(digests, (_BATCH, n, 8), "fused1 digests")
+                c.dtype(digests, "uint32", "fused1 digests")
+                c.shape(flags, (_BATCH, m, w // group), "fused1 flags")
+                c.dtype(flags, "bool", "fused1 flags")
+                c.shape(packed, (_BATCH, m, w), "fused1 packed")
+                c.dtype(packed, "uint32", "fused1 packed")
+            except Exception as e:
+                c.fail(e)
+
+    covers("codec_step", "verify_and_reconstruct_words")
+    c = ctx(
+        codec_step.verify_and_reconstruct_words,
+        "minio_tpu/ops/codec_step.py",
+    )
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        present = (False,) * m + (True,) * (n - m)
+        c.config = cfg_str(k, m, L) + " [portable]"
+        try:
+            data, ok = codec_step.verify_and_reconstruct_words.eval_shape(
+                S((_BATCH, n, w), u32), S((_BATCH, n, 8), u32),
+                present, k, m, L,
+            )
+            c.shape(data, (_BATCH, k, w), "fused GET data words")
+            c.dtype(data, "uint32", "fused GET data words")
+            c.shape(ok, (_BATCH, n), "fused GET ok mask")
+            c.dtype(ok, "bool", "fused GET ok mask")
+            # MTPU203: fused1 encode -> fused1 verify+reconstruct closes
+            parity, digests, _, _ = (
+                codec_step.encode_words_fused1.eval_shape(
+                    S((_BATCH, k, w), u32), m, L, 0
+                )
+            )
+            rt, _ = codec_step.verify_and_reconstruct_words.eval_shape(
+                S((_BATCH, k + parity.shape[1], w), parity.dtype),
+                S(tuple(digests.shape), digests.dtype),
+                present, k, m, L,
+            )
+            c.expect(
+                "MTPU203",
+                (tuple(rt.shape), str(rt.dtype)),
+                ((_BATCH, k, w), "uint32"),
+                "fused1 encode->verify+reconstruct round-trip (words)",
+            )
+        except Exception as e:
+            c.fail(e)
+    for k, m, L in FUSED_GRID:
+        w, n = L // 4, k + m
+        present = (False,) * m + (True,) * (n - m)
+        for formulation in ("swar", "mxu"):
+            c.config = cfg_str(k, m, L) + f" [pallas, {formulation}]"
+            try:
+                data, ok = (
+                    codec_step.verify_and_reconstruct_words.eval_shape(
+                        S((_BATCH, n, w), u32), S((_BATCH, n, 8), u32),
+                        present, k, m, L, formulation, True, True,
+                    )
+                )
+                c.shape(data, (_BATCH, k, w), "fused GET data words")
+                c.dtype(data, "uint32", "fused GET data words")
+                c.shape(ok, (_BATCH, n), "fused GET ok mask")
+                c.dtype(ok, "bool", "fused GET ok mask")
+            except Exception as e:
+                c.fail(e)
+
     # ---- select_step.py: S3 Select scan kernels -------------------------
     #
     # SWAR flag-words are uint64, so every contract evaluates under
@@ -577,6 +687,56 @@ def run() -> "list[Finding]":
         except Exception as e:
             c.fail(e)
 
+    covers("rs_pallas", "encode_pack_fused")
+    c = ctx(rs_pallas.encode_pack_fused, "minio_tpu/ops/rs_pallas.py")
+    for k, m, L in FUSED_GRID:
+        w, n = L // 4, k + m
+        for group in (0, 256):
+            g = w // group if group else 0
+            for formulation in ("swar", "mxu"):
+                c.config = (
+                    cfg_str(k, m, L) + f" [group={group}, {formulation}]"
+                )
+                try:
+                    parity, hacc, flags, packed = (
+                        rs_pallas.encode_pack_fused.eval_shape(
+                            S((_BATCH, k, w), u32), m, group,
+                            formulation, True,
+                        )
+                    )
+                    c.shape(parity, (_BATCH, m, w), "fused1 parity")
+                    c.dtype(parity, "uint32", "fused1 parity")
+                    c.shape(hacc, (_BATCH, n, 8), "fused1 hash partials")
+                    c.dtype(hacc, "uint32", "fused1 hash partials")
+                    c.shape(flags, (_BATCH, m, g), "fused1 flag words")
+                    c.dtype(flags, "uint32", "fused1 flag words")
+                    c.shape(packed, (_BATCH, m, w), "fused1 packed")
+                    c.dtype(packed, "uint32", "fused1 packed")
+                except Exception as e:
+                    c.fail(e)
+
+    covers("rs_pallas", "verify_reconstruct_fused")
+    c = ctx(rs_pallas.verify_reconstruct_fused, "minio_tpu/ops/rs_pallas.py")
+    for k, m, L in FUSED_GRID:
+        w, n = L // 4, k + m
+        # worst admissible erasure: all m losses fall on data shards
+        idx = tuple(range(m, n))[:k]
+        for formulation in ("swar", "mxu"):
+            c.config = cfg_str(k, m, L) + f" [{formulation}]"
+            try:
+                data, hacc = (
+                    rs_pallas.verify_reconstruct_fused.eval_shape(
+                        S((_BATCH, n, w), u32), idx, k, m,
+                        formulation, True,
+                    )
+                )
+                c.shape(data, (_BATCH, k, w), "fused GET data words")
+                c.dtype(data, "uint32", "fused GET data words")
+                c.shape(hacc, (_BATCH, n, 8), "fused GET hash partials")
+                c.dtype(hacc, "uint32", "fused GET hash partials")
+            except Exception as e:
+                c.fail(e)
+
     # ---- parallel/mesh.py: compile-seam mesh kernels --------------------
     #
     # Mesh kernels are not module-level jitted attrs: they are built per
@@ -711,6 +871,26 @@ def run() -> "list[Finding]":
                 )
                 c.shape(out, (_BATCH, 8), "mesh digests")
                 c.dtype(out, "uint32", "mesh digests")
+            except Exception as e:
+                c.fail(e)
+
+    mesh_checked.add("mesh_verify_reconstruct")
+    c = mesh_ctx("mesh_verify_reconstruct")
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        present = (False,) * m + (True,) * (n - m)
+        for mode in mesh_modes("mesh_verify_reconstruct"):
+            c.config = cfg_str(k, m, L) + f" [{mode}]"
+            try:
+                data, ok = mesh_eval(
+                    "mesh_verify_reconstruct", mode,
+                    (S((_BATCH, n, w), u32), S((_BATCH, n, 8), u32)),
+                    dict(k=k, m=m, present=present, shard_len=L),
+                )
+                c.shape(data, (_BATCH, k, w), "mesh fused GET data words")
+                c.dtype(data, "uint32", "mesh fused GET data words")
+                c.shape(ok, (_BATCH, n), "mesh fused GET ok mask")
+                c.dtype(ok, "bool", "mesh fused GET ok mask")
             except Exception as e:
                 c.fail(e)
 

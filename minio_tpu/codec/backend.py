@@ -40,6 +40,16 @@ def _record_d2h(plane: str, nbytes: int) -> None:
     KERNEL_STATS.record_d2h(plane, int(nbytes))
 
 
+def _record_pass(kernel: str) -> None:
+    """Account one device-program launch (jitted codec pass) by entry
+    point name.  The fused1 acceptance gate reads these counters: the
+    legacy PUT seam launches three passes per batch (digest encode,
+    group_flags, pack_nonzero_groups) and fused1 exactly one."""
+    from .telemetry import KERNEL_STATS
+
+    KERNEL_STATS.record_pass(kernel)
+
+
 # ---------------------------------------------------------------------------
 # Device-resident parity plane: refs + the bounded write-back cache
 # ---------------------------------------------------------------------------
@@ -148,26 +158,66 @@ class _DeviceParityRef:
     prefix (ops/codec_step.pack_nonzero_groups), not the raw plane,
     crosses the bus.  Registered with the ParityPlaneCache until
     drained or released.
+
+    Under the fused1 kernel the occupancy ``flags`` and the prefix
+    ``packed`` plane are produced by the SAME pallas_call as the parity
+    itself (ops/rs_pallas.encode_pack_fused), so the ref carries them
+    and the drain launches ZERO further device passes — it only picks
+    which precomputed plane crosses the bus.  The legacy ref (no
+    precomputed planes) launches group_flags + pack_nonzero_groups at
+    drain time as before.
     """
 
-    __slots__ = ("_lk", "_cache", "_parity_w", "_host", "nbytes")
+    __slots__ = (
+        "_lk",
+        "_cache",
+        "_parity_w",
+        "_flags",
+        "_packed",
+        "_group",
+        "_host",
+        "nbytes",
+    )
 
-    def __init__(self, cache: ParityPlaneCache, parity_w):
+    def __init__(
+        self,
+        cache: ParityPlaneCache,
+        parity_w,
+        flags=None,
+        packed=None,
+        group: int = 0,
+    ):
         self._lk = threading.Lock()
         self._cache = cache
         self._parity_w = parity_w
+        self._flags = flags
+        self._packed = packed
+        self._group = int(group)
         self._host: "np.ndarray | None" = None
-        self.nbytes = int(
+        plane = int(
             parity_w.shape[0] * parity_w.shape[1] * parity_w.shape[2] * 4
         )
+        # the packed twin is a second device-resident plane of the same
+        # size: account it honestly against the write-back budget
+        self.nbytes = plane * (2 if packed is not None else 1)
         cache.add(self)
 
     def drain(self) -> np.ndarray:
         """(B, m, L) uint8 parity bytes, materialized at most once."""
         with self._lk:
             if self._host is None and self._parity_w is not None:
-                self._host = self._drain_d2h(self._parity_w)
+                if self._packed is not None:
+                    self._host = self._drain_precomputed(
+                        self._parity_w,
+                        self._flags,
+                        self._packed,
+                        self._group,
+                    )
+                else:
+                    self._host = self._drain_d2h(self._parity_w)
                 self._parity_w = None
+                self._flags = None
+                self._packed = None
                 self._cache.forget(self)
             return self._host
 
@@ -177,6 +227,8 @@ class _DeviceParityRef:
         with self._lk:
             if self._parity_w is not None:
                 self._parity_w = None
+                self._flags = None
+                self._packed = None
                 self._cache.forget(self)
 
     @staticmethod
@@ -190,6 +242,7 @@ class _DeviceParityRef:
         G = compmod.PARITY_GROUP_WORDS
         g = w // G if w % G == 0 else 0
         if mode != "off" and g >= 2:
+            _record_pass("group_flags")
             flags = np.asarray(codec_step.group_flags(parity_w, G))
             kept = int(flags.sum(axis=-1).max()) if flags.size else 0
             if kept == 0:
@@ -201,14 +254,47 @@ class _DeviceParityRef:
                 mode == "on"
                 or kept / g <= compmod.parity_fill_threshold()
             ):
+                _record_pass("pack_nonzero_groups")
                 _f, packed = codec_step.pack_nonzero_groups(parity_w, G)
-                # power-of-two prefix: each distinct D2H slice shape is
-                # its own compiled gather, so bound the zoo at O(log g)
-                keep = min(1 << (kept - 1).bit_length(), g)
+                keep = compmod.prefix_keep(kept, g)
                 prefix = np.asarray(packed[..., : keep * G])
                 _record_d2h("parity", flags.nbytes + prefix.nbytes)
                 words = compmod.unpack_nonzero_groups(
                     flags, prefix, G, w
+                )
+                return codec_step.host_words_to_bytes(words)
+        parity = np.asarray(parity_w)
+        _record_d2h("parity", parity.nbytes)
+        return codec_step.host_words_to_bytes(parity)
+
+    @staticmethod
+    def _drain_precomputed(parity_w, flags_d, packed_d, group) -> np.ndarray:
+        """fused1 drain: occupancy screen + pack came out of the encode
+        pallas_call itself, so no device pass launches here — only the
+        chosen plane's D2H (flags are a few bytes per row)."""
+        from ..ops import codec_step
+        from . import compress as compmod
+
+        mode = compmod.device_compress_mode()
+        w = int(parity_w.shape[-1])
+        g = w // group
+        flags = np.asarray(flags_d)  # (B, m, g) bool, tiny
+        if mode != "off":
+            kept = int(flags.sum(axis=-1).max()) if flags.size else 0
+            if kept == 0:
+                _record_d2h("parity", flags.nbytes)
+                return np.zeros(
+                    parity_w.shape[:-1] + (w * 4,), dtype=np.uint8
+                )
+            if (
+                mode == "on"
+                or kept / g <= compmod.parity_fill_threshold()
+            ):
+                keep = compmod.prefix_keep(kept, g)
+                prefix = np.asarray(packed_d[..., : keep * group])
+                _record_d2h("parity", flags.nbytes + prefix.nbytes)
+                words = compmod.unpack_nonzero_groups(
+                    flags, prefix, group, w
                 )
                 return codec_step.host_words_to_bytes(words)
         parity = np.asarray(parity_w)
@@ -503,11 +589,13 @@ class TpuBackend(CodecBackend):
                 mesh, codec_step.host_bytes_to_words(data),
                 parity_shards, L,
             )
+            _record_pass("mesh_encode_hash")
             return _AsyncHandle("async-mesh", h)
         words = jnp.asarray(codec_step.host_bytes_to_words(data))
         parity_w, digests = codec_step.encode_and_hash_words(
             words, parity_shards, L
         )
+        _record_pass("encode_and_hash_words")
         return _AsyncHandle("async", (parity_w, digests))
 
     def encode_end(self, handle):
@@ -544,7 +632,13 @@ class TpuBackend(CodecBackend):
 
     def encode_digest_begin(self, data, parity_shards):
         """Digest-only start: the fused donated kernel keeps parity on
-        device; only the 32-byte digests are scheduled for readback."""
+        device; only the 32-byte digests are scheduled for readback.
+
+        Under MINIO_TPU_CODEC_KERNEL=fused1 (default) the single pass
+        additionally emits the occupancy flags and the nonzero-group
+        prefix pack, so the eventual drain launches nothing; ``legacy``
+        keeps the three-pass structure as the bisection oracle.
+        """
         import jax.numpy as jnp
 
         from ..ops import codec_step
@@ -559,14 +653,53 @@ class TpuBackend(CodecBackend):
                 "digest-eager", self.encode_begin(data, parity_shards)
             )
         words = jnp.asarray(codec_step.host_bytes_to_words(data))
+        if codec_step.codec_kernel_mode() == "fused1":
+            from . import compress as compmod
+
+            w = L // 4
+            G = compmod.PARITY_GROUP_WORDS
+            group = (
+                G
+                if (
+                    compmod.device_compress_mode() != "off"
+                    and w % G == 0
+                    and w // G >= 2
+                )
+                else 0
+            )
+            use_pallas, interpret = codec_step.pallas_dispatch(w)
+            parity_w, digests, flags_d, packed_d = (
+                codec_step.encode_words_fused1(
+                    words,
+                    parity_shards,
+                    L,
+                    group=group,
+                    formulation=codec_step.codec_formulation(),
+                    use_pallas=use_pallas,
+                    interpret=interpret,
+                )
+            )
+            _record_pass("encode_words_fused1")
+            return _AsyncHandle(
+                "digest-fused1",
+                (
+                    parity_w,
+                    digests,
+                    flags_d if group else None,
+                    packed_d if group else None,
+                    group,
+                ),
+            )
         parity_w, digests = codec_step.encode_and_hash_words_digest(
             words, parity_shards, L
         )
+        _record_pass("encode_and_hash_words_digest")
         return _AsyncHandle("digest", (parity_w, digests))
 
     def encode_digest_end(self, handle):
         if not isinstance(handle, _AsyncHandle) or handle.kind not in (
             "digest",
+            "digest-fused1",
             "digest-eager",
         ):
             return super().encode_digest_end(handle)
@@ -578,6 +711,22 @@ class TpuBackend(CodecBackend):
                 np.asarray(digests),
                 _EagerParityRef(
                     np.ascontiguousarray(parity, dtype=np.uint8)
+                ),
+            )
+        elif handle.kind == "digest-fused1":
+            # digests are the ONLY eager readback (MTPU107); parity,
+            # flags and packed stay device-resident behind the ref
+            parity_w, digests_d, flags_d, packed_d, group = handle.payload
+            digests = np.asarray(digests_d)
+            _record_d2h("data", digests.nbytes)
+            result = (
+                digests,
+                _DeviceParityRef(
+                    parity_plane_cache(),
+                    parity_w,
+                    flags=flags_d,
+                    packed=packed_d,
+                    group=group,
                 ),
             )
         else:
@@ -620,12 +769,80 @@ class TpuBackend(CodecBackend):
                 data_shards,
                 parity_shards,
             )
+            _record_pass("mesh_reconstruct")
             return codec_step.host_words_to_bytes(dw)
         words = jnp.asarray(codec_step.host_bytes_to_words(shards))
         dw = codec_step.reconstruct_words_batch(
             words, tuple(bool(b) for b in present), data_shards, parity_shards
         )
+        _record_pass("reconstruct_words_batch")
         return codec_step.host_words_to_bytes(np.asarray(dw))
+
+    def reconstruct_and_verify(
+        self, shards, digests, present, data_shards, parity_shards
+    ):
+        """Fused GET-side pass (fused1): digest checks + survivor decode
+        in ONE device pass (codec_step.verify_and_reconstruct_words),
+        replacing the verify -> reconstruct pair on the quorum-read/heal
+        path.  Optimistic like CpuBackend: decode from the first k
+        present rows while hashing all of them; on the rare digest
+        mismatch among the chosen survivors, re-pick survivors from the
+        verified mask and re-solve just the hit stripes.  The legacy
+        mode composes the separate passes (bisection oracle)."""
+        import jax.numpy as jnp
+
+        from ..ops import codec_step
+
+        if codec_step.codec_kernel_mode() != "fused1":
+            return super().reconstruct_and_verify(
+                shards, digests, present, data_shards, parity_shards
+            )
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        pres = np.asarray(present, dtype=bool)
+        B, n, L = shards.shape
+        present_t = tuple(bool(b) for b in pres)
+        words = codec_step.host_bytes_to_words(shards)
+        mesh = self._mesh_for(B, data_shards)
+        if mesh is not None:
+            from ..parallel import mesh as pm
+
+            dw, ok = pm.mesh_verify_reconstruct(
+                mesh,
+                words,
+                np.asarray(digests),
+                present_t,
+                data_shards,
+                parity_shards,
+                L,
+            )
+            _record_pass("mesh_verify_reconstruct")
+        else:
+            use_pallas, interpret = codec_step.pallas_dispatch(L // 4)
+            dw_d, ok_d = codec_step.verify_and_reconstruct_words(
+                jnp.asarray(words),
+                jnp.asarray(digests),
+                present_t,
+                data_shards,
+                parity_shards,
+                L,
+                formulation=codec_step.codec_formulation(),
+                use_pallas=use_pallas,
+                interpret=interpret,
+            )
+            _record_pass("verify_and_reconstruct_words")
+            dw = np.asarray(dw_d)
+            ok = np.asarray(ok_d)
+        data = codec_step.host_words_to_bytes(dw)
+        surv = np.nonzero(pres)[0][:data_shards]
+        bad = ~ok[:, surv].all(axis=1)
+        if bad.any():
+            idxs = np.nonzero(bad)[0]
+            if not data.flags.writeable:  # zero-copy view of a jax buffer
+                data = data.copy()
+            data[idxs] = self._reconstruct_from_ok(
+                shards[idxs], ok[idxs], data_shards, parity_shards
+            )
+        return data, ok
 
     def digest(self, shards):
         import jax.numpy as jnp
@@ -640,9 +857,11 @@ class TpuBackend(CodecBackend):
 
             words = codec_step.host_bytes_to_words(shards)
             flat = words.reshape(B * n, -1)
+            _record_pass("mesh_digest")
             return pm.mesh_digest(mesh, flat, L).reshape(B, n, 8)
         words = jnp.asarray(codec_step.host_bytes_to_words(shards))
         got = phash.phash256_words_batched(words, L)
+        _record_pass("phash256_words_batched")
         return np.asarray(got)
 
 
@@ -782,6 +1001,8 @@ class CpuBackend(CodecBackend):
         bad = ~ok[:, surv].all(axis=1)
         if bad.any():
             idxs = np.nonzero(bad)[0]
+            if not data.flags.writeable:  # zero-copy view of a jax buffer
+                data = data.copy()
             data[idxs] = self._reconstruct_from_ok(
                 shards[idxs], ok[idxs], data_shards, parity_shards
             )

@@ -124,6 +124,20 @@ def parity_fill_threshold() -> float:
     return min(1.0, max(0.0, v))
 
 
+def prefix_keep(kept: int, groups: int) -> int:
+    """Packed-prefix length (in groups) to move over the bus.
+
+    Rounded up to a power of two so each distinct D2H slice shape is
+    its own compiled gather and the shape zoo stays O(log g).  Shared
+    by both drain paths (the legacy pack-at-drain kernel and the
+    fused1 precomputed planes), so the two can never round differently
+    and break bit-identity of the unpacked result.
+    """
+    if kept <= 0:
+        return 0
+    return min(1 << (kept - 1).bit_length(), groups)
+
+
 def unpack_nonzero_groups(
     flags: np.ndarray, packed_prefix: np.ndarray, group: int, w: int
 ) -> np.ndarray:

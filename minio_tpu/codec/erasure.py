@@ -797,11 +797,12 @@ class Erasure:
             )
             heal = heal or g_heal
             # verify stays a separate pass HERE (unlike heal, which
-            # uses the fused reconstruct_and_verify): the quorum read
-            # needs per-shard verdicts BEFORE deciding whether to
-            # escalate to more reads, and on the healthy path there is
-            # no reconstruct at all - fusing would decode k rows per
-            # group that the fast path below streams out as views
+            # uses the fused reconstruct_and_verify - ONE device
+            # launch under fused1): the quorum read needs per-shard
+            # verdicts BEFORE deciding whether to escalate to more
+            # reads, and on the healthy path there is no reconstruct
+            # at all - fusing would decode k rows per group that the
+            # fast path below streams out as views
             # reconstruct per distinct pattern (usually one)
             t0 = time.monotonic()
             patterns: dict[tuple, list[int]] = {}
@@ -1144,8 +1145,11 @@ class Erasure:
                 present[s] = True
             # fused GET-side pass: digest checks + survivor decode in
             # one memory pass over the frames (CpuBackend runs it as a
-            # single native call; other backends compose verify +
-            # reconstruct behind the same seam)
+            # single native call; TpuBackend under fused1 runs it as
+            # ONE device launch - codec_step.verify_and_reconstruct_
+            # words / mesh_verify_reconstruct - and composes the
+            # legacy verify + reconstruct pair only as the bisection
+            # oracle, MINIO_TPU_CODEC_KERNEL=legacy)
             try:
                 data, ok = be.reconstruct_and_verify(
                     shards, digests, present, k, m

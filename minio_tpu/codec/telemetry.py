@@ -63,6 +63,9 @@ class KernelStats:
         # The parity-plane PUT restructure exists to drive the parity
         # row of this table to the post-ack drain band only
         self._d2h: "dict[str, list]" = {}
+        # device-program launches by jitted entry point: the fused1
+        # acceptance gate (legacy PUT seam = 3 passes/batch, fused1 = 1)
+        self._passes: "dict[str, int]" = {}
         # submesh placement: outcome ("span"|"route") -> batches, and
         # per-submesh in-flight depth (current + high-water mark)
         self._placement: "dict[str, int]" = {}
@@ -105,6 +108,12 @@ class KernelStats:
             row = self._d2h.setdefault(plane, [0, 0])
             row[0] += 1
             row[1] += nbytes
+
+    def record_pass(self, kernel: str) -> None:
+        """One device-program launch (jitted codec pass) by entry-point
+        name — backend.py records these at every launch site."""
+        with self._mu:
+            self._passes[kernel] = self._passes.get(kernel, 0) + 1
 
     def record_stages(self, op: str, stages: "dict[str, float]") -> None:
         """One stream's stage breakdown (assemble / codec / disk)."""
@@ -191,6 +200,7 @@ class KernelStats:
                     {"plane": plane, "transfers": n, "bytes": nbytes}
                     for plane, (n, nbytes) in sorted(self._d2h.items())
                 ],
+                "device_passes": dict(sorted(self._passes.items())),
                 "parity_cache": _parity_cache_stats(),
                 "hedge": {
                     kind: self._hedge.get(kind, 0)
@@ -252,6 +262,7 @@ class KernelStats:
             self._iopool_slowest_s = 0.0
             self._hedge.clear()
             self._d2h.clear()
+            self._passes.clear()
             self._placement.clear()
             self._submesh_depth.clear()
             self._submesh_depth_hwm.clear()

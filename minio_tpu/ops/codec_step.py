@@ -20,6 +20,7 @@ These are the kernels the object layer batches concurrent requests into
 from __future__ import annotations
 
 import functools
+import os
 import warnings
 
 import jax
@@ -153,6 +154,205 @@ def pack_nonzero_groups(words: jax.Array, group: int):
         grouped, order[..., None], axis=-2
     ).reshape(*lead, w)
     return flags, packed
+
+
+# ---------------------------------------------------------------------------
+# One-kernel codec (fused1): PUT and GET as one device pass per direction
+# ---------------------------------------------------------------------------
+
+
+def codec_kernel_mode() -> str:
+    """MINIO_TPU_CODEC_KERNEL: ``fused1`` (default) or ``legacy``.
+
+    ``legacy`` is the bisection oracle: the exact pre-fusion pass
+    structure (digest encode pass, then group_flags, then
+    pack_nonzero_groups at drain; verify then reconstruct on heal) with
+    byte-identical outputs.  Flip it to attribute a regression to the
+    fused kernels vs everything around them.
+    """
+    v = os.environ.get("MINIO_TPU_CODEC_KERNEL", "fused1").strip().lower()
+    return v if v in ("fused1", "legacy") else "fused1"
+
+
+def codec_formulation() -> str:
+    """MINIO_TPU_CODEC_FORMULATION: ``swar`` (default) or ``mxu``.
+
+    Picks the GF(2^8) matrix-product formulation inside the fused
+    kernels (see rs_pallas module doc); both are bit-exact.
+    """
+    v = os.environ.get(
+        "MINIO_TPU_CODEC_FORMULATION", "swar"
+    ).strip().lower()
+    return v if v in ("swar", "mxu") else "swar"
+
+
+def pallas_dispatch(words_per_shard: int) -> tuple[bool, bool]:
+    """(use_pallas, interpret) statics for the fused1 entry points.
+
+    Pallas runs compiled on TPU; MINIO_TPU_CODEC_INTERPRET=1 forces the
+    interpreter on other backends (the CI kernel-regression mode,
+    mirroring MINIO_TPU_SANITIZE); everything else takes the portable
+    XLA path inside the same jit program, which is the same math.
+    """
+    if words_per_shard % rs_pallas._TW:
+        return False, False
+    if jax.default_backend() == "tpu":
+        return True, False
+    if os.environ.get("MINIO_TPU_CODEC_INTERPRET") == "1":
+        return True, True
+    return False, False
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "parity_shards",
+        "shard_len",
+        "group",
+        "formulation",
+        "use_pallas",
+        "interpret",
+    ),
+    donate_argnums=(0,),
+)
+def encode_words_fused1(
+    words: jax.Array,
+    parity_shards: int,
+    shard_len: int,
+    group: int = 0,
+    formulation: str = "swar",
+    use_pallas: bool = False,
+    interpret: bool = False,
+):
+    """fused1 PUT codec step: parity + digests + occupancy + pack in ONE
+    device pass.
+
+    The legacy pipeline runs encode_and_hash_words_digest, then
+    group_flags, then pack_nonzero_groups at drain time - three jitted
+    passes re-reading the parity plane from HBM.  This entry fuses all
+    three: on TPU (or under interpret) it is exactly one pallas_call
+    (rs_pallas.encode_pack_fused); elsewhere it is one portable XLA
+    program with the same math.
+
+    words: (B, k, w) u32, DONATED like encode_and_hash_words_digest.
+    Returns (parity (B, m, w) u32, digests (B, n, 8) u32 finalized,
+    flags (B, m, g) bool, packed (B, m, w) u32) with g = w // group;
+    group == 0 disables the pack leg (flags has g == 0, packed aliases
+    parity).  Only ``digests`` may be materialized eagerly (MTPU107);
+    parity/flags/packed park in the parity plane cache until drain.
+    """
+    batch, k, w = words.shape
+    m = parity_shards
+    if shard_len != 4 * w:
+        raise ValueError("shard_len must equal 4 * words-per-shard")
+    if w % 8:
+        raise ValueError("words per shard must be a multiple of 8")
+    if group and w % group:
+        raise ValueError("words per shard must be a multiple of group")
+
+    if use_pallas and m > 0 and w % rs_pallas._TW == 0:
+        parity, partials, flags_u, packed = rs_pallas.encode_pack_fused(
+            words,
+            m,
+            group=group,
+            formulation=formulation,
+            interpret=interpret,
+        )
+        digests = phash.finalize_partials(partials, shard_len)
+        return parity, digests, flags_u != 0, packed
+
+    # Portable single-program path: the legacy three-pass math
+    # (encode_and_hash_words + group_flags + pack_nonzero_groups) fused
+    # into one XLA program - the bit-identity oracle for the kernel.
+    if m > 0:
+        matrix = gf.parity_matrix(k, m)
+        flat = words.transpose(1, 0, 2).reshape(k, batch * w)
+        parity = rs._matmul_static(flat, matrix).reshape(m, batch, w)
+        aw = jnp.concatenate([words.transpose(1, 0, 2), parity], axis=0)
+        parity = parity.transpose(1, 0, 2)
+    else:
+        parity = jnp.zeros((batch, 0, w), jnp.uint32)
+        aw = words.transpose(1, 0, 2)
+    digests = phash.phash256_words_batched(aw, shard_len).transpose(1, 0, 2)
+    if not group:
+        return parity, digests, jnp.zeros((batch, m, 0), bool), parity
+    g = w // group
+    grouped = parity.reshape(batch, m, g, group)
+    flags = (grouped != 0).any(axis=-1)
+    idx = jnp.arange(g, dtype=jnp.int32)
+    key = jnp.where(flags, 0, jnp.int32(g)) + idx
+    order = jnp.argsort(key, axis=-1)
+    packed = jnp.take_along_axis(
+        grouped, order[..., None], axis=-2
+    ).reshape(batch, m, w)
+    return parity, digests, flags, packed
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "present",
+        "data_shards",
+        "parity_shards",
+        "shard_len",
+        "formulation",
+        "use_pallas",
+        "interpret",
+    ),
+)
+def verify_and_reconstruct_words(
+    shards: jax.Array,
+    digests: jax.Array,
+    present: tuple[bool, ...],
+    data_shards: int,
+    parity_shards: int,
+    shard_len: int,
+    formulation: str = "swar",
+    use_pallas: bool = False,
+    interpret: bool = False,
+):
+    """fused1 GET codec step: digest-verify + reconstruct in ONE pass.
+
+    Replaces the verify_hashes_words -> reconstruct_words_batch pair on
+    the quorum-read/heal path: one pallas_call (or one portable XLA
+    program) reads each shard byte once for both the bitrot check and
+    the RS product.
+
+    shards: (B, n, w) u32 as read (absent rows hold garbage); digests:
+    (B, n, 8) u32 stored; present: static per-row availability.
+    Returns (data (B, k, w) u32 reconstructed from the first k present
+    rows, ok (B, n) bool = digest match AND present).  The caller
+    rechecks ok over its chosen survivors and re-solves per-stripe when
+    one was corrupt (backend reconstruct_and_verify escalation).
+    """
+    k, m = data_shards, parity_shards
+    B, n, w = shards.shape
+    if shard_len != 4 * w:
+        raise ValueError("shard_len must equal 4 * words-per-shard")
+    idx = [i for i, p in enumerate(present) if p][:k]
+    if len(idx) < k:
+        raise ValueError(f"need {k} shards, have {len(idx)}")
+    pres = jnp.asarray(np.asarray(present, dtype=bool))
+    if use_pallas and w % rs_pallas._TW == 0:
+        data, partials = rs_pallas.verify_reconstruct_fused(
+            shards,
+            tuple(idx),
+            k,
+            m,
+            formulation=formulation,
+            interpret=interpret,
+        )
+        got = phash.finalize_partials(partials, shard_len)
+    else:
+        got = phash.phash256_words_batched(shards, shard_len)
+        rm = gf.reconstruction_matrix(k, m, tuple(idx))
+        flat = shards.transpose(1, 0, 2).reshape(n, B * w)
+        surv = jnp.stack([flat[i] for i in idx])
+        data = (
+            rs._matmul_static(surv, rm).reshape(k, B, w).transpose(1, 0, 2)
+        )
+    ok = jnp.all(got == digests, axis=-1) & pres
+    return data, ok
 
 
 @functools.partial(jax.jit, static_argnames=("shard_len",))

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from . import gf, rs
 
@@ -104,11 +105,37 @@ def encode_words(data_words, parity_shards: int, interpret=None):
 # ---------------------------------------------------------------------------
 
 
-def _fused_kernel_factory(matrix: np.ndarray, tw: int):
+def _tile_hash_partials(all_rows, i, tw: int):
+    """phash256 partials of (rows, tw) shard words at w-tile index i.
+
+    Shared by every fused kernel; XOR-accumulate the (rows, 8) result
+    into a revisited output block and finalize with
+    hash.finalize_partials outside the kernel.
+    """
     from . import hash as phash
 
+    gidx = i * tw + jax.lax.broadcasted_iota(jnp.uint32, (1, tw), 1)
+    key = phash._mix_jnp(gidx * phash._C1 + jnp.uint32(1))  # (1, tw)
+    m1 = phash._mix_jnp((all_rows ^ key) * phash._M1)
+    m2 = phash._mix_jnp((all_rows + key) * phash._M2)
+
+    def red(x):
+        # XOR-fold the lane dim down to 4: every halving step keeps
+        # index-mod-4 classes intact (all widths are multiples of 4),
+        # so the result is exactly the strided partition XOR.  Mosaic
+        # has no reduce_xor and no lane-dim shape casts; slices + xor
+        # lower cleanly.
+        width = tw
+        while width > 4:
+            width //= 2
+            x = x[:, :width] ^ x[:, width : 2 * width]
+        return x  # (rows, 4)
+
+    return jnp.concatenate([red(m1), red(m2)], axis=1)  # (rows, 8)
+
+
+def _fused_kernel_factory(matrix: np.ndarray, tw: int):
     m, k = matrix.shape
-    n = k + m
 
     def kernel(data_ref, parity_ref, hacc_ref):
         i = pl.program_id(1)
@@ -125,25 +152,7 @@ def _fused_kernel_factory(matrix: np.ndarray, tw: int):
         )  # (n, tw)
         parity_ref[0] = all_rows[k:]
         # ---- hash partials for this tile, all shards at once ----
-        gidx = i * tw + jax.lax.broadcasted_iota(jnp.uint32, (1, tw), 1)
-        key = phash._mix_jnp(gidx * phash._C1 + jnp.uint32(1))  # (1, tw)
-        m1 = phash._mix_jnp((all_rows ^ key) * phash._M1)
-        m2 = phash._mix_jnp((all_rows + key) * phash._M2)
-
-        def red(x):
-            # XOR-fold the lane dim down to 4: every halving step keeps
-            # index-mod-4 classes intact (all widths are multiples of 4),
-            # so the result is exactly the strided partition XOR.  Mosaic
-            # has no reduce_xor and no lane-dim shape casts; slices + xor
-            # lower cleanly.
-            width = tw
-            while width > 4:
-                width //= 2
-                x = x[:, :width] ^ x[:, width : 2 * width]
-            return x  # (n, 4)
-
-        partials = jnp.concatenate([red(m1), red(m2)], axis=1)  # (n, 8)
-        hacc_ref[0] = hacc_ref[0] ^ partials
+        hacc_ref[0] = hacc_ref[0] ^ _tile_hash_partials(all_rows, i, tw)
 
     return kernel
 
@@ -294,3 +303,331 @@ def gf_matmul_mxu(
     shards = jnp.asarray(shards, dtype=jnp.uint8)
     key = np.ascontiguousarray(matrix, dtype=np.uint8).tobytes()
     return _mxu_matmul_jit(shards, key, o, s, interpret)
+
+
+# ---------------------------------------------------------------------------
+# One-kernel codec (fused1): single pass per direction
+# ---------------------------------------------------------------------------
+
+
+def _mxu_rows(matrix: np.ndarray, data, mat=None) -> list:
+    """MXU formulation of _swar_rows: (s, t) u32 tile -> o output rows.
+
+    Lifts the bytewise GF(2^8) product to the (8o, 8s) GF(2) bit matrix
+    (_bit_matrix) and evaluates all four byte positions of every word in
+    ONE bf16 matmul mod 2: the codec is byte-local, so byte positions
+    stack on the lane dim.  Exact because every intermediate is a small
+    integer (bit-counts <= 8s < 2^8) carried in f32.
+
+    ``mat`` is the pre-lifted bit matrix when called inside a Pallas
+    kernel (kernels cannot capture traced constants, so the caller
+    threads it through an input ref); None rebuilds it from ``matrix``.
+    """
+    o, s = matrix.shape
+    if o == 0:
+        return []
+    t = data.shape[-1]
+    if mat is None:
+        key = np.ascontiguousarray(matrix, dtype=np.uint8).tobytes()
+        mat = jnp.asarray(_bit_matrix(key, o, s))
+    mat = mat.astype(jnp.bfloat16)
+    # (s, 4t): byte plane j of every word, side by side on the lane dim
+    bts = jnp.concatenate(
+        [(data >> jnp.uint32(8 * j)) & jnp.uint32(0xFF) for j in range(4)],
+        axis=-1,
+    ).astype(jnp.int32)
+    bits = jnp.stack(
+        [(bts >> b) & 1 for b in range(8)], axis=1
+    )  # (s, 8, 4t): row order 8c+b after reshape
+    bits = bits.reshape(8 * s, 4 * t).astype(jnp.bfloat16)
+    counts = jnp.dot(mat, bits, preferred_element_type=jnp.float32)
+    pbits = (counts.astype(jnp.int32) & 1).reshape(o, 8, 4 * t)
+    acc8 = pbits[:, 0, :].astype(jnp.uint32)
+    for tbit in range(1, 8):
+        acc8 = acc8 | (pbits[:, tbit, :].astype(jnp.uint32) << tbit)
+    out = acc8[:, :t]
+    for j in range(1, 4):
+        out = out | (acc8[:, j * t : (j + 1) * t] << jnp.uint32(8 * j))
+    return [out[r] for r in range(o)]
+
+
+def _rows_fn(formulation: str):
+    if formulation == "swar":
+        return _swar_rows
+    if formulation == "mxu":
+        return _mxu_rows
+    raise ValueError(f"unknown codec formulation: {formulation!r}")
+
+
+def _fused1_kernel_factory(
+    matrix: np.ndarray, tw: int, group: int, formulation: str
+):
+    m, k = matrix.shape
+    mxu = _rows_fn(formulation) is _mxu_rows
+    gpt = tw // group if group else 0
+
+    def impl(data_ref, parity_ref, hacc_ref, flags_ref, packed_ref,
+             kept_ref, mat):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _zero():
+            hacc_ref[...] = jnp.zeros_like(hacc_ref)
+            if group:
+                packed_ref[...] = jnp.zeros_like(packed_ref)
+                for r in range(m):
+                    kept_ref[r] = 0
+
+        data = data_ref[0]  # (k, tw)
+        parity_rows = (
+            _mxu_rows(matrix, data, mat) if mxu else _swar_rows(matrix, data)
+        )
+        all_rows = jnp.concatenate(
+            [data, jnp.stack(parity_rows)], axis=0
+        )  # (n, tw)
+        parity_ref[0] = all_rows[k:]
+        hacc_ref[0] = hacc_ref[0] ^ _tile_hash_partials(all_rows, i, tw)
+        if not group:
+            return
+        # ---- occupancy flags + prefix pack of this tile's groups ----
+        # The packed row block is resident in VMEM for the whole w-tile
+        # loop of a stripe; an SMEM counter per parity row carries the
+        # next free group slot across the (sequential) grid steps.  Zero
+        # groups are never stored: the row starts zeroed, which makes
+        # the result bit-identical to the legacy argsort pack
+        # (codec_step.pack_nonzero_groups).
+        flags = []
+        for r in range(m):
+            flags.append(
+                [
+                    jnp.any(
+                        parity_rows[r][j * group : (j + 1) * group] != 0
+                    )
+                    for j in range(gpt)
+                ]
+            )
+        flags_ref[0] = jnp.stack(
+            [jnp.stack(fr).astype(jnp.uint32) for fr in flags]
+        )
+        for r in range(m):
+            off = kept_ref[r]
+            for j in range(gpt):
+
+                @pl.when(flags[r][j])
+                def _store(off=off, r=r, j=j):
+                    packed_ref[0, r, pl.ds(off * group, group)] = (
+                        parity_rows[r][j * group : (j + 1) * group]
+                    )
+
+                off = off + flags[r][j].astype(jnp.int32)
+            kept_ref[r] = off
+
+    if mxu and group:
+
+        def kernel(mat_ref, data_ref, parity_ref, hacc_ref, flags_ref,
+                   packed_ref, kept_ref):
+            impl(data_ref, parity_ref, hacc_ref, flags_ref, packed_ref,
+                 kept_ref, mat_ref[...])
+
+    elif mxu:
+
+        def kernel(mat_ref, data_ref, parity_ref, hacc_ref):
+            impl(data_ref, parity_ref, hacc_ref, None, None, None,
+                 mat_ref[...])
+
+    elif group:
+
+        def kernel(data_ref, parity_ref, hacc_ref, flags_ref, packed_ref,
+                   kept_ref):
+            impl(data_ref, parity_ref, hacc_ref, flags_ref, packed_ref,
+                 kept_ref, None)
+
+    else:
+
+        def kernel(data_ref, parity_ref, hacc_ref):
+            impl(data_ref, parity_ref, hacc_ref, None, None, None, None)
+
+    return kernel
+
+
+def _mxu_operand(matrix: np.ndarray):
+    """(bit-matrix input list, matching in_spec list) for an MXU kernel."""
+    o, s = matrix.shape
+    key = np.ascontiguousarray(matrix, dtype=np.uint8).tobytes()
+    mat = jnp.asarray(_bit_matrix(key, o, s))
+    return [mat], [pl.BlockSpec((8 * o, 8 * s), lambda b, i: (0, 0))]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("parity_shards", "group", "formulation", "interpret"),
+)
+def encode_pack_fused(
+    words,
+    parity_shards: int,
+    group: int = 0,
+    formulation: str = "swar",
+    interpret: bool = False,
+):
+    """One-kernel PUT codec pass (fused1): parity + bitrot partials +
+    group-occupancy flags + nonzero-group prefix pack, ONE pallas_call.
+
+    words: (B, k, w) u32.  Returns (parity (B, m, w) u32, partials
+    (B, n, 8) u32 un-finalized, flags (B, m, g) u32 0/1, packed
+    (B, m, w) u32) with g = w // group.  group == 0 disables the pack
+    leg: flags has g == 0 and packed aliases parity.
+
+    Same grid as encode_hash_fused; the parity tile is additionally
+    screened per 256-word group and nonzero groups are appended to the
+    VMEM-resident packed row at the slot a per-row SMEM counter tracks
+    (TPU grids run sequentially, so the counter survives the w-tile
+    loop).  The raw parity plane is still emitted - the drain picks raw
+    vs packed by fill AFTER the fact - and each data byte is read from
+    HBM exactly once.
+    """
+    B, k, w = words.shape
+    m = parity_shards
+    n = k + m
+    if m <= 0:
+        raise ValueError("encode_pack_fused needs parity_shards >= 1")
+    if w % _TW:
+        raise ValueError(f"words per shard ({w}) must be a multiple of {_TW}")
+    if group and _TW % group:
+        raise ValueError(f"group must divide the {_TW}-word tile")
+    matrix = gf.parity_matrix(k, m)
+    kernel = _fused1_kernel_factory(matrix, _TW, group, formulation)
+    extra_in, extra_specs = (
+        _mxu_operand(matrix) if formulation == "mxu" else ([], [])
+    )
+    in_specs = extra_specs + [
+        pl.BlockSpec((1, k, _TW), lambda b, i: (b, 0, i))
+    ]
+    if not group:
+        parity, hacc = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((B, m, w), jnp.uint32),
+                jax.ShapeDtypeStruct((B, n, 8), jnp.uint32),
+            ),
+            grid=(B, w // _TW),
+            in_specs=in_specs,
+            out_specs=(
+                pl.BlockSpec((1, m, _TW), lambda b, i: (b, 0, i)),
+                pl.BlockSpec((1, n, 8), lambda b, i: (b, 0, 0)),
+            ),
+            interpret=interpret,
+        )(*extra_in, words)
+        return parity, hacc, jnp.zeros((B, m, 0), jnp.uint32), parity
+    g = w // group
+    gpt = _TW // group
+    parity, hacc, flags, packed = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, m, w), jnp.uint32),
+            jax.ShapeDtypeStruct((B, n, 8), jnp.uint32),
+            jax.ShapeDtypeStruct((B, m, g), jnp.uint32),
+            jax.ShapeDtypeStruct((B, m, w), jnp.uint32),
+        ),
+        grid=(B, w // _TW),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((1, m, _TW), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, n, 8), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, m, gpt), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, m, w), lambda b, i: (b, 0, 0)),
+        ),
+        scratch_shapes=[pltpu.SMEM((m,), jnp.int32)],
+        interpret=interpret,
+    )(*extra_in, words)
+    return parity, hacc, flags, packed
+
+
+def _vr_kernel_factory(
+    rmatrix: np.ndarray, idx: tuple, n: int, tw: int, formulation: str
+):
+    mxu = _rows_fn(formulation) is _mxu_rows
+
+    def impl(sh_ref, data_ref, hacc_ref, mat):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _zero():
+            hacc_ref[...] = jnp.zeros_like(hacc_ref)
+
+        sh = sh_ref[0]  # (n, tw), rows AS READ (absent rows: garbage)
+        surv = jnp.stack([sh[j, :] for j in idx])  # (k, tw) static gather
+        rows = (
+            _mxu_rows(rmatrix, surv, mat) if mxu else _swar_rows(rmatrix, surv)
+        )
+        data_ref[0] = jnp.stack(rows)
+        hacc_ref[0] = hacc_ref[0] ^ _tile_hash_partials(sh, i, tw)
+
+    if mxu:
+
+        def kernel(mat_ref, sh_ref, data_ref, hacc_ref):
+            impl(sh_ref, data_ref, hacc_ref, mat_ref[...])
+
+    else:
+
+        def kernel(sh_ref, data_ref, hacc_ref):
+            impl(sh_ref, data_ref, hacc_ref, None)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "present_idx",
+        "data_shards",
+        "parity_shards",
+        "formulation",
+        "interpret",
+    ),
+)
+def verify_reconstruct_fused(
+    shards,
+    present_idx: tuple,
+    data_shards: int,
+    parity_shards: int,
+    formulation: str = "swar",
+    interpret: bool = False,
+):
+    """One-kernel GET codec pass: bitrot partials for every shard row +
+    reconstruction from the static survivor set, ONE pallas_call.
+
+    shards: (B, n, w) u32 as read; present_idx: the k survivor row
+    indices (static).  Returns (data (B, k, w) u32, partials (B, n, 8)
+    u32 un-finalized - finalize and compare against stored digests
+    outside; each shard byte is read from HBM exactly once for both).
+    """
+    B, n, w = shards.shape
+    k, m = data_shards, parity_shards
+    if n != k + m:
+        raise ValueError("shard rows must equal k + m")
+    idx = tuple(int(i) for i in present_idx)
+    if len(idx) != k:
+        raise ValueError(f"need exactly {k} survivor indices, got {len(idx)}")
+    if w % _TW:
+        raise ValueError(f"words per shard ({w}) must be a multiple of {_TW}")
+    rm = gf.reconstruction_matrix(k, m, idx)
+    kernel = _vr_kernel_factory(rm, idx, n, _TW, formulation)
+    extra_in, extra_specs = (
+        _mxu_operand(rm) if formulation == "mxu" else ([], [])
+    )
+    data, hacc = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, k, w), jnp.uint32),
+            jax.ShapeDtypeStruct((B, n, 8), jnp.uint32),
+        ),
+        grid=(B, w // _TW),
+        in_specs=extra_specs
+        + [pl.BlockSpec((1, n, _TW), lambda b, i: (b, 0, i))],
+        out_specs=(
+            pl.BlockSpec((1, k, _TW), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, n, 8), lambda b, i: (b, 0, 0)),
+        ),
+        interpret=interpret,
+    )(*extra_in, shards)
+    return data, hacc

@@ -254,6 +254,28 @@ def _digest_global(mesh: Mesh, shard_len: int):
     return step
 
 
+def _verify_reconstruct_global(
+    mesh: Mesh,
+    k: int,
+    m: int,
+    present: tuple[bool, ...],
+    shard_len: int,
+):
+    from ..ops import codec_step
+
+    def step(words: jax.Array, digests: jax.Array):
+        # words: (B, n, w) quorum rows; stripes are device-local on the
+        # stripe axis, so the fused GET step (verify + reconstruct in
+        # one program) partitions with no collective.  The portable
+        # formulation keeps the program XLA-partitionable; the Pallas
+        # kernel stays on the single-device path.
+        return codec_step.verify_and_reconstruct_words(
+            words, digests, present, k, m, shard_len
+        )
+
+    return step
+
+
 rules.register_kernel(
     "sharded_encode",
     in_names=("stripe_bytes",),
@@ -289,6 +311,12 @@ rules.register_kernel(
     in_names=("digest_rows",),
     out_names=("digest_out",),
     build_global=_digest_global,
+)
+rules.register_kernel(
+    "mesh_verify_reconstruct",
+    in_names=("quorum_words", "quorum_digests"),
+    out_names=("recon_words", "ok_mask"),
+    build_global=_verify_reconstruct_global,
 )
 
 
@@ -449,6 +477,42 @@ def mesh_reconstruct(
     )
     dd = put_sharded(mesh, surv, rules.spec_for("survivor_words"))
     return np.asarray(fn(dd))[:B]
+
+
+def mesh_verify_reconstruct(
+    mesh: Mesh,
+    words: np.ndarray,
+    digests: np.ndarray,
+    present: tuple[bool, ...],
+    data_shards: int,
+    parity_shards: int,
+    shard_len: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Mesh-parallel fused GET step: verify digests + reconstruct, one program.
+
+    words: (B, n, w) quorum rows, digests: (B, n, 8) expected phash256 -
+    both sharded over "stripe".  Returns ((B, k, w) data, (B, n) ok mask).
+    Padded stripes hash to garbage and come back ok=False; the [:B] slice
+    drops them before anyone looks.
+    """
+    k, m = data_shards, parity_shards
+    B = words.shape[0]
+    stripe = mesh.shape["stripe"]
+    rows = _bucket_batch(B, stripe)
+    words = _pad_batch(words, rows)
+    digests = _pad_batch(digests, rows)
+    fn = rules.compile_kernel(
+        "mesh_verify_reconstruct",
+        mesh,
+        k=k,
+        m=m,
+        present=tuple(bool(p) for p in present),
+        shard_len=shard_len,
+    )
+    dw = put_sharded(mesh, words, rules.spec_for("quorum_words"))
+    dg = put_sharded(mesh, digests, rules.spec_for("quorum_digests"))
+    data, ok = fn(dw, dg)
+    return np.asarray(data)[:B], np.asarray(ok)[:B]
 
 
 def mesh_digest(mesh: Mesh, words: np.ndarray, shard_len: int) -> np.ndarray:

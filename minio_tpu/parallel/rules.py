@@ -94,6 +94,12 @@ PARTITION_RULES: tuple[tuple[str, PartitionSpec], ...] = (
     (r"^parity_digests$", PartitionSpec("stripe", None, None)),
     # (B, k, w) reconstructed data: whole stripes, replicated over shard
     (r"^recon_words$", PartitionSpec("stripe", None, None)),
+    # (B, n, w|8) quorum-read planes (fused verify+reconstruct): all n
+    # shard rows of a stripe stay together - the bitrot check is
+    # row-local but the decode needs every survivor row
+    (r"^quorum_(words|digests)$", PartitionSpec("stripe", None, None)),
+    # (B, n) per-shard verify verdicts
+    (r"^ok_mask$", PartitionSpec("stripe", None)),
     # (R, w) flattened digest rows: spread over every device on both axes
     (r"^digest_(rows|out)$", PartitionSpec(("stripe", "shard"), None)),
     # (k, L) sequence-parallel stream: length over every device

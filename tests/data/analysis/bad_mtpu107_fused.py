@@ -1,0 +1,33 @@
+"""Fixture: eager readback around the one-kernel (fused1) seam (MTPU107).
+
+Linted under the rel_path ``minio_tpu/ops/bad_mtpu107_fused.py`` so the
+parity-readback scope applies.  The fused1 PUT pass returns four device
+outputs (parity, digests, flags, packed) — only the digests may go eager
+at the begin/end seam; the parity plane and its packed twin must stay
+device-resident until drain.  Each offending line carries a
+``# VIOLATION: MTPU###`` marker.
+"""
+
+import jax
+import numpy as np
+
+
+def encode_fused1_begin(words, parity_shards):
+    parity, digests, flags, packed_parity = fused1(words, parity_shards)
+    plane = np.asarray(parity)  # VIOLATION: MTPU107
+    return plane, np.asarray(digests), flags, packed_parity
+
+
+def stash_packed_plane(packed_parity):
+    # the prefix-packed twin is still a parity plane: same rule
+    twin = np.array(packed_parity)  # VIOLATION: MTPU107
+    return twin
+
+
+def sync_fused_outputs(parity_w):
+    host = jax.device_get(parity_w)  # VIOLATION: MTPU107 # VIOLATION: MTPU101
+    return host
+
+
+def fused1(words, parity_shards):
+    return words, words, words, words

@@ -133,7 +133,11 @@ KNOWN_ENTRY_POINTS = {
     ("rs_pallas", "_matmul_words_jit"),
     ("rs_pallas", "_mxu_matmul_jit"),
     ("rs_pallas", "encode_hash_fused"),
+    ("rs_pallas", "encode_pack_fused"),
+    ("rs_pallas", "verify_reconstruct_fused"),
     ("codec_step", "encode_and_hash_words"),
+    ("codec_step", "encode_words_fused1"),
+    ("codec_step", "verify_and_reconstruct_words"),
     ("codec_step", "encode_and_hash_words_digest"),
     ("codec_step", "group_flags"),
     ("codec_step", "pack_nonzero_groups"),
@@ -245,6 +249,44 @@ def test_mtpu107_silent_outside_parity_scope():
     assert not any(f.rule == "MTPU107" for f in found), "\n".join(
         f.render() for f in found
     )
+
+
+def test_bad_mtpu107_fused_seam_exact_findings():
+    """The one-kernel (fused1) seam: parity plane AND its prefix-packed
+    twin stay device-resident; eager readback of either outside the
+    begin/end/drain seams fires MTPU107."""
+    expected = _expected_markers("bad_mtpu107_fused.py")
+    assert expected, "bad_mtpu107_fused.py declares no VIOLATION markers"
+    got = {
+        (f.rule, f.line)
+        for f in _lint_fixture(
+            "bad_mtpu107_fused.py",
+            rel_path="minio_tpu/ops/bad_mtpu107_fused.py",
+        )
+    }
+    assert got == expected
+
+
+def test_good_mtpu107_fused_seam_clean():
+    """Digest-only eager output at the fused1 begin seam plus parity /
+    packed materialization inside *_end / drain lint clean."""
+    found = _lint_fixture(
+        "good_mtpu107_fused.py",
+        rel_path="minio_tpu/ops/good_mtpu107_fused.py",
+    )
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_mtpu107_fused_seam_applies_to_codec_backend_file():
+    found = _lint_fixture(
+        "bad_mtpu107_fused.py", rel_path="minio_tpu/codec/backend.py"
+    )
+    rules = {(f.rule, f.line) for f in found}
+    assert {
+        (r, ln)
+        for r, ln in _expected_markers("bad_mtpu107_fused.py")
+        if r == "MTPU107"
+    } <= rules
 
 
 # -- MTPU108: event-loop-blocking lint is scoped to server/ -------------
