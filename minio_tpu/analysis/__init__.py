@@ -1,6 +1,6 @@
 """minio_tpu.analysis: project-native static analysis.
 
-Four passes over the codebase's invariants (the Python/JAX stand-ins
+Five passes over the codebase's invariants (the Python/JAX stand-ins
 for the go-vet / staticcheck / race-detector triad the reference MinIO
 leans on):
 
@@ -10,7 +10,14 @@ leans on):
   Python↔C seam (utils/native.py vs native/csrc/gf_cpu.cc);
 * ``kernel_contracts``— abstract-eval contracts MTPU201-204 for every
   jitted codec entry point (CPU-only, via jax.eval_shape);
-* ``lockorder``       — runtime lock-graph audit MTPU301-302.
+* ``lockorder``       — runtime lock-graph audit MTPU301-302;
+* ``deviceflow``      — interprocedural device-dataflow rules
+  MTPU501-505 (use-after-donate, D2H escapes, thread-boundary
+  captures, call-graph-deep blocking-under-async, registry drift)
+  over the ``callgraph`` module's whole-tree call graph.
+
+The file-walking passes share one mtime-keyed AST cache
+(``astcache.CACHE``) so a five-pass run parses each file exactly once.
 
 Run ``python -m minio_tpu.analysis`` (tier-1 runs the same passes via
 tests/test_analysis.py).  Suppress a deliberate violation with
@@ -99,23 +106,79 @@ def run_lint(paths: "list[str] | None" = None) -> "list[Finding]":
     longer fires is itself a finding.
     """
     from . import abi_contracts
+    from .astcache import CACHE
     from .hotpath_lint import lint_source
 
     findings: "list[Finding]" = []
     sources: "dict[str, list[str]]" = {}
     for rel in iter_py_files(paths):
-        lines = _read_lines(rel)
-        sources[rel] = lines
-        text = "\n".join(lines) + "\n"
-        raw = lint_source(rel, text)
+        mod = CACHE.get(rel)
+        sources[rel] = mod.lines
+        if mod.tree is None:
+            findings.append(
+                Finding(
+                    "MTPU100",
+                    rel,
+                    (mod.error.lineno or 1) if mod.error else 1,
+                    "syntax error: "
+                    + (mod.error.msg if mod.error else "unparseable"),
+                )
+            )
+            continue
+        raw = lint_source(rel, mod.text, tree=mod.tree)
         findings.extend(raw)
         raw_for_audit = list(raw)
         if rel == abi_contracts.PY_REL:
             raw_for_audit.extend(abi_contracts.raw_run())
-        findings.extend(unused_suppressions(rel, text, raw_for_audit))
+        findings.extend(unused_suppressions(rel, mod.text, raw_for_audit))
     return sorted(
         filter_suppressed(findings, sources), key=Finding.sort_key
     )
+
+
+def run_deviceflow_report(
+    paths: "list[str] | None" = None,
+    restrict: "set[str] | None" = None,
+):
+    """Deviceflow pass (MTPU501-505) with its callgraph report.
+
+    Returns ``(findings, report)`` where findings are noqa-filtered
+    (with the pass's own MTPU5xx staleness audit folded in) and
+    ``report`` carries the call graph + timings for ``--json``.  The
+    analysis is always whole-set — provenance is an interprocedural
+    fact — but ``restrict`` (a repo-relative path set, e.g. the
+    reverse-dependency closure of changed files) limits which files'
+    findings are REPORTED, which is the sound form of --changed-only.
+    """
+    from .astcache import CACHE
+    from .deviceflow import analyze_sources
+
+    sources = CACHE.load(iter_py_files(paths))
+    report = analyze_sources(sources)
+    by_path: "dict[str, list[Finding]]" = {}
+    for f in report.findings:
+        by_path.setdefault(f.path, []).append(f)
+    findings = list(report.findings)
+    for rel, mod in sources.items():
+        findings.extend(
+            unused_suppressions(
+                rel, mod.text, by_path.get(rel, []), prefixes=("MTPU5",)
+            )
+        )
+    lines = {rel: mod.lines for rel, mod in sources.items()}
+    findings = filter_suppressed(findings, lines)
+    if restrict is not None:
+        findings = [f for f in findings if f.path in restrict]
+    return sorted(findings, key=Finding.sort_key), report
+
+
+def run_deviceflow(
+    paths: "list[str] | None" = None,
+    restrict: "set[str] | None" = None,
+) -> "list[Finding]":
+    """Interprocedural device-dataflow checks (MTPU501-505)."""
+    findings, _ = run_deviceflow_report(paths, restrict)
+    return findings
 
 
 def run_abi() -> "list[Finding]":
@@ -143,14 +206,55 @@ def run_all(
     paths: "list[str] | None" = None,
     skip: "set[str] | None" = None,
 ) -> "list[Finding]":
+    findings, _, _ = run_all_timed(paths, skip)
+    return findings
+
+
+def run_all_timed(
+    paths: "list[str] | None" = None,
+    skip: "set[str] | None" = None,
+    deviceflow_restrict: "set[str] | None" = None,
+):
+    """All passes, with per-pass wall time.
+
+    Returns ``(findings, pass_seconds, callgraph_stats)`` —
+    ``pass_seconds`` maps each pass that ran to its wall time (the
+    analyzer's cost is tracked like a benchmark), ``callgraph_stats``
+    is the deviceflow pass's graph summary (or None when skipped).
+    """
+    import time
+
     skip = skip or set()
     findings: "list[Finding]" = []
+    pass_seconds: "dict[str, float]" = {}
+    callgraph_stats = None
+
+    def timed(name, fn):
+        t0 = time.monotonic()
+        findings.extend(fn())
+        pass_seconds[name] = round(time.monotonic() - t0, 3)
+
     if "lint" not in skip:
-        findings.extend(run_lint(paths))
+        timed("lint", lambda: run_lint(paths))
     if "abi" not in skip:
-        findings.extend(run_abi())
+        timed("abi", run_abi)
     if "contracts" not in skip:
-        findings.extend(run_contracts())
+        timed("contracts", run_contracts)
     if "locks" not in skip:
-        findings.extend(run_locks())
-    return sorted(findings, key=Finding.sort_key)
+        timed("locks", run_locks)
+    if "deviceflow" not in skip:
+        t0 = time.monotonic()
+        # a restrict set implies whole-tree analysis (the closure was
+        # computed over the whole graph); otherwise honor --paths
+        df, report = run_deviceflow_report(
+            None if deviceflow_restrict is not None else paths,
+            restrict=deviceflow_restrict,
+        )
+        findings.extend(df)
+        pass_seconds["deviceflow"] = round(time.monotonic() - t0, 3)
+        callgraph_stats = report.graph.stats()
+    return (
+        sorted(findings, key=Finding.sort_key),
+        pass_seconds,
+        callgraph_stats,
+    )

@@ -7,8 +7,16 @@ tests/test_analysis.py.
 ``--changed-only`` narrows the run to what the working tree actually
 touches (vs HEAD, plus untracked files): lint runs over just the
 changed .py files, and the tree-global passes (contracts, abi, locks)
-run only when a file they audit changed.  This keeps the gate fast as
-the tree grows without weakening a full run.
+run only when a file they audit changed.  The deviceflow pass is
+interprocedural, so prefix gating would be UNSOUND for it — editing a
+callee can create or remove a finding in a caller — instead it always
+analyzes the whole tree and reports findings for the reverse-dependency
+closure of the changed files over the call graph.  This keeps the gate
+fast as the tree grows without weakening a full run.
+
+``--json`` emits ``{"findings": [...], "passes": {pass: seconds},
+"callgraph": {nodes, edges, boundary_edges, seconds}}`` so analyzer
+cost is tracked like a benchmark.
 """
 
 from __future__ import annotations
@@ -49,7 +57,7 @@ PASS_TRIGGER_PREFIXES = {
     ),
 }
 
-PASSES = ("lint", "abi", "contracts", "locks")
+PASSES = ("lint", "abi", "contracts", "locks", "deviceflow")
 
 
 def _changed_files(repo_root: str) -> "set[str]":
@@ -75,13 +83,13 @@ def main(argv: "list[str] | None" = None) -> int:
     # contract checks must not require an accelerator
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    from . import REPO_ROOT, RULES, run_all
+    from . import REPO_ROOT, RULES, run_all_timed
 
     ap = argparse.ArgumentParser(
         prog="python -m minio_tpu.analysis",
         description="minio-tpu project-native static analysis "
         "(hot-path lint, ABI contracts, kernel contracts, lock-order "
-        "audit)",
+        "audit, interprocedural device-dataflow)",
         epilog="directories named "
         + ", ".join(EXCLUDED_DIR_NAMES)
         + " are always excluded from file-walking passes",
@@ -96,7 +104,8 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument(
         "--json",
         action="store_true",
-        help="emit findings as a stable-sorted JSON array (diffable)",
+        help="emit a JSON object: stable-sorted findings, per-pass "
+        "wall-time seconds, and call-graph stats (diffable)",
     )
     ap.add_argument(
         "--skip",
@@ -126,6 +135,7 @@ def main(argv: "list[str] | None" = None) -> int:
 
     skip = set(args.skip)
     paths = args.paths
+    deviceflow_restrict = None
     suffix = ""
     if args.changed_only:
         suffix = ", changed-only"
@@ -142,13 +152,31 @@ def main(argv: "list[str] | None" = None) -> int:
         for pass_name, prefixes in PASS_TRIGGER_PREFIXES.items():
             if not any(p.startswith(prefixes) for p in changed):
                 skip.add(pass_name)
+        if lint_paths:
+            # deviceflow findings are interprocedural: analyze the
+            # whole tree, report for the changed files PLUS everything
+            # that transitively calls into them (prefix gating would
+            # silently skip a caller whose callee just changed)
+            deviceflow_restrict = _reverse_closure(set(lint_paths))
+        else:
+            skip.add("deviceflow")
 
-    findings = run_all(paths=paths, skip=skip)
+    findings, pass_seconds, callgraph_stats = run_all_timed(
+        paths=paths,
+        skip=skip,
+        deviceflow_restrict=deviceflow_restrict,
+    )
 
     if args.json:
         print(
             json.dumps(
-                [f.to_dict() for f in findings], indent=2, sort_keys=True
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "passes": pass_seconds,
+                    "callgraph": callgraph_stats,
+                },
+                indent=2,
+                sort_keys=True,
             )
         )
     else:
@@ -161,6 +189,18 @@ def main(argv: "list[str] | None" = None) -> int:
             file=sys.stderr,
         )
     return 1 if findings else 0
+
+
+def _reverse_closure(changed: "set[str]") -> "set[str]":
+    """Changed files plus every file that transitively calls into them,
+    over the whole-tree call graph (the sound --changed-only set for
+    the interprocedural pass)."""
+    from . import iter_py_files
+    from .astcache import CACHE
+    from .callgraph import build
+
+    graph = build(CACHE.load(iter_py_files()))
+    return graph.reverse_file_closure(changed)
 
 
 if __name__ == "__main__":

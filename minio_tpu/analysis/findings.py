@@ -114,6 +114,40 @@ RULES: "dict[str, str]" = {
         "ABI contract: numpy buffer reaches .ctypes.data_as() without "
         "contiguity evidence (ascontiguousarray/require/flags assert)"
     ),
+    "MTPU501": (
+        "device dataflow: use-after-donate — a value passed at a "
+        "donate_argnums position of a registered donating entry point "
+        "is read again afterwards (XLA may alias the donated buffer "
+        "into an output; the PR 14 bug class, caught statically)"
+    ),
+    "MTPU502": (
+        "device dataflow: interprocedural D2H escape — a "
+        "device-provenance value (return of a registered jitted entry "
+        "point, through any chain of calls) reaches np.asarray / "
+        "bytes() / .item() / jax.device_get outside a registered drain "
+        "seam (whole-tree generalization of MTPU107/111)"
+    ),
+    "MTPU503": (
+        "device dataflow: device value captured across a thread/loop "
+        "boundary (iopool.submit*, worker-pool submit/spawn_stream, "
+        "run_coroutine_threadsafe, run_in_executor, Thread(target=)) "
+        "without materialization — the D2H becomes a hidden sync on an "
+        "arbitrary thread"
+    ),
+    "MTPU504": (
+        "device dataflow: call-graph-deep blocking-under-async — a "
+        "blocking call (time.sleep, raw socket I/O, Future.result(), "
+        "non-asyncio .wait()) in a sync function reachable from a "
+        "minio_tpu/server async def through plain calls, so it runs on "
+        "the event loop (MTPU108 one-or-more frames deep; worker-pool "
+        "boundary edges exempt the sanctioned sync-def bridges)"
+    ),
+    "MTPU505": (
+        "device dataflow: registry drift — kernel_contracts declares a "
+        "jitted entry point, donation position, or drain seam the tree "
+        "does not have, or the tree declares one the registry misses "
+        "(the MTPU403 orphan-check discipline for dataflow facts)"
+    ),
 }
 
 
@@ -186,20 +220,25 @@ def filter_suppressed(
 
 # Only codes of the file-anchored passes are audited for staleness: 1xx
 # (lint) and 4xx (ABI) anchor at source lines, so "does it fire here"
-# is well-defined.  Foreign codes (BLE001, F401, ...) belong to other
-# tools; MTPU106 on a line is the sanctioned keep-this-suppression
-# escape hatch and MTPU100 is the syntax-error sentinel.
+# is well-defined — the deviceflow pass audits its own 5xx codes the
+# same way, passing its prefix explicitly.  Foreign codes (BLE001,
+# F401, ...) belong to other tools; MTPU106 on a line is the sanctioned
+# keep-this-suppression escape hatch and MTPU100 is the syntax-error
+# sentinel.
 _AUDITED_PREFIXES = ("MTPU1", "MTPU4")
 _AUDIT_EXEMPT = ("MTPU100", "MTPU106")
 
 
 def unused_suppressions(
-    rel_path: str, text: str, raw_findings: "list[Finding]"
+    rel_path: str,
+    text: str,
+    raw_findings: "list[Finding]",
+    prefixes: "tuple[str, ...]" = _AUDITED_PREFIXES,
 ) -> "list[Finding]":
     """MTPU106: noqa'd MTPU rules that do not fire on their line.
 
     ``raw_findings`` must be PRE-noqa-filter findings for this file
-    from every file-anchored pass whose codes the file suppresses —
+    from every file-anchored pass whose codes ``prefixes`` covers —
     otherwise a working suppression looks unused.  Comments are found
     with tokenize, so a ``# noqa:`` inside a docstring is ignored.
     """
@@ -222,7 +261,7 @@ def unused_suppressions(
             continue  # no noqa, or a bare one (out of audit scope)
         line = tok.start[0]
         for code in sorted(codes):
-            if not code.startswith(_AUDITED_PREFIXES):
+            if not code.startswith(prefixes):
                 continue
             if code in _AUDIT_EXEMPT:
                 continue

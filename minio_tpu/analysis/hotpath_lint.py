@@ -636,19 +636,26 @@ class _Linter(ast.NodeVisitor):
         return _dotted(t) in ("Exception", "BaseException")
 
 
-def lint_source(rel_path: str, text: str) -> "list[Finding]":
-    """Lint one file's source; returns findings BEFORE noqa filtering."""
-    try:
-        tree = ast.parse(text, filename=rel_path)
-    except SyntaxError as e:
-        return [
-            Finding(
-                "MTPU100",
-                rel_path,
-                e.lineno or 1,
-                f"syntax error: {e.msg}",
-            )
-        ]
+def lint_source(
+    rel_path: str, text: str, tree: "ast.Module | None" = None
+) -> "list[Finding]":
+    """Lint one file's source; returns findings BEFORE noqa filtering.
+
+    ``tree`` lets callers hand in an already-parsed module (the shared
+    AST cache) so a five-pass run parses each file exactly once.
+    """
+    if tree is None:
+        try:
+            tree = ast.parse(text, filename=rel_path)
+        except SyntaxError as e:
+            return [
+                Finding(
+                    "MTPU100",
+                    rel_path,
+                    e.lineno or 1,
+                    f"syntax error: {e.msg}",
+                )
+            ]
     linter = _Linter(rel_path)
     linter.visit(tree)
     return linter.findings

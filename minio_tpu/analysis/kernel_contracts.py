@@ -47,6 +47,123 @@ FUSED_GRID = [
 
 _BATCH = 3  # leading batch dim for the batched kernels
 
+# ---------------------------------------------------------------------------
+# Device-dataflow registry (consumed by analysis/callgraph.py and
+# analysis/deviceflow.py).
+#
+# These tables are the single source of truth for the whole-program
+# MTPU5xx dataflow rules: which calls *produce* device-resident values,
+# which argument positions are *donated* (dead after the call), and
+# which functions are the sanctioned *drain seams* where device values
+# may legally materialize on host.  MTPU505 cross-checks every table
+# against the tree (a declared fact absent in code, or a code fact
+# absent here, is a finding), so the registry cannot rot — the same
+# discipline MTPU403 applies to the native export table.
+# ---------------------------------------------------------------------------
+
+# short module name -> repo-relative path of the module that defines it
+ENTRY_POINT_PATHS = {
+    "rs": "minio_tpu/ops/rs.py",
+    "rs_pallas": "minio_tpu/ops/rs_pallas.py",
+    "codec_step": "minio_tpu/ops/codec_step.py",
+    "hash": "minio_tpu/ops/hash.py",
+    "select_step": "minio_tpu/ops/select_step.py",
+    "backend": "minio_tpu/codec/backend.py",
+    "mesh": "minio_tpu/parallel/mesh.py",
+    "rules": "minio_tpu/parallel/rules.py",
+}
+
+# Every jitted entry point the tree ships, (module_short_name, attr).
+# Introspection (jit_entry_points) must find at least these — tier-1
+# asserts it — and the callgraph pass must resolve a def node for each.
+# Calls to any of these return device-resident values.
+KNOWN_ENTRY_POINTS = {
+    ("rs", "_encode_jit"),
+    ("rs", "_reconstruct_jit"),
+    ("rs", "_reconstruct_static_jit"),
+    ("rs_pallas", "_matmul_words_jit"),
+    ("rs_pallas", "_mxu_matmul_jit"),
+    ("rs_pallas", "encode_hash_fused"),
+    ("rs_pallas", "encode_pack_fused"),
+    ("rs_pallas", "verify_reconstruct_fused"),
+    ("codec_step", "encode_and_hash_words"),
+    ("codec_step", "encode_and_hash_words_digest"),
+    ("codec_step", "encode_words_fused1"),
+    ("codec_step", "verify_and_reconstruct_words"),
+    ("codec_step", "group_flags"),
+    ("codec_step", "pack_nonzero_groups"),
+    ("codec_step", "verify_hashes_words"),
+    ("codec_step", "reconstruct_words_batch"),
+    ("codec_step", "encode_throughput_probe"),
+    ("codec_step", "reconstruct_throughput_probe"),
+    ("codec_step", "verify_throughput_probe"),
+    ("select_step", "screen_chunk"),
+    ("select_step", "extract_positions"),
+    ("select_step", "row_spans"),
+    ("select_step", "anchors_back"),
+    ("select_step", "gather_rows"),
+}
+
+# (module_short_name, attr) -> donated positional argument indices.
+# A value passed at a donated position is DEAD after the call (XLA may
+# alias its buffer into an output); reading it again is the PR 14 bug
+# class, caught statically as MTPU501.  MTPU505 cross-checks this table
+# against the ``donate_argnums`` literals in the jit decorators.
+DONATING_ENTRY_POINTS = {
+    ("codec_step", "encode_and_hash_words_digest"): (0,),
+    ("codec_step", "encode_words_fused1"): (0,),
+}
+
+# Mesh kernel kinds registered with the rules.py compile seam that
+# declare donation (register_kernel(..., donate_argnums=...)).  MTPU505
+# cross-checks against the register_kernel call sites in the tree.
+MESH_DONATING_KERNELS = {
+    "mesh_encode_hash": (0,),
+}
+
+# repo-relative path -> function names that are sanctioned drain seams:
+# inside these, device values may materialize on host (np.asarray /
+# bytes / .item() / jax.device_get), and their RETURN values are host
+# facts, not device facts.  Names ending in ``_end`` or containing
+# ``drain`` in these files MUST be registered here (MTPU505), so a new
+# seam cannot appear without joining the audited set.
+DRAIN_SEAMS = {
+    "minio_tpu/codec/backend.py": (
+        # PUT side: the begin/end split and the lazy parity-plane drain
+        "encode_end",
+        "encode_digest_end",
+        "drain",
+        "_drain_d2h",
+        "_drain_precomputed",
+        # GET side: decode IS the sanctioned D2H — reconstructed rows
+        # leave the device here and nowhere else
+        "reconstruct",
+        "reconstruct_and_verify",
+        "verify",
+        "digest",
+    ),
+    "minio_tpu/s3select/device.py": (
+        # candidate row bytes are the only payload that crosses D2H,
+        # through exactly these functions (MTPU111 enforces locally)
+        "_drain_scalars",
+        "_drain_array",
+        "_drain_fallback_chunk",
+        "drain_plane",
+    ),
+    "minio_tpu/ops/codec_step.py": (
+        # byte-domain convenience wrappers: eager by design (tests and
+        # small host-side callers), documented in the module
+        "encode_and_hash",
+        "verify_hashes",
+        "decode_and_verify",
+    ),
+    "minio_tpu/parallel/mesh.py": (
+        # the mesh pipeline's sync point: begin dispatches async,
+        # _end materializes — the double-buffer overlap contract
+        "mesh_encode_hash_end",
+    ),
+}
+
 
 def _ops_modules():
     # codec.backend is watched too: the PR 4 fused-codec seams

@@ -1,0 +1,17 @@
+"""MTPU504 fixture: blocking call ONE FRAME BELOW an async def — the
+sync helper runs on the event loop because the async handler calls it
+through a plain edge.  MTPU108 cannot see this (the sleep is not
+lexically inside an async def); the call-graph pass can.
+
+Analyzed under a minio_tpu/server/ rel_path (the rule's root scope),
+like the MTPU107/108 fixtures."""
+
+import time
+
+
+def _fsync_meta(path):
+    time.sleep(0.01)  # VIOLATION: MTPU504
+
+
+async def handle_put(conn, path):
+    _fsync_meta(path)

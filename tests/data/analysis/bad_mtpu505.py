@@ -1,0 +1,24 @@
+"""MTPU505 fixture: registry drift seeds — donation facts declared in
+code that the kernel_contracts registry does not know about.  A
+donating jit decorator and a donating register_kernel call outside the
+registered tables both fire."""
+
+import functools
+
+import jax
+
+from minio_tpu.parallel import rules
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def fused_probe(words, parity_shards):  # VIOLATION: MTPU505
+    return words
+
+
+def _build(words):
+    return words
+
+
+rules.register_kernel(  # VIOLATION: MTPU505
+    "probe_kernel", _build, donate_argnums=(1,)
+)

@@ -1,0 +1,14 @@
+"""MTPU501 twin: after donating ``words`` the caller only touches the
+kernel's RESULTS — the donated name is never read again."""
+
+import jax.numpy as jnp
+
+from minio_tpu.ops import codec_step
+
+
+def put_object(data, parity_shards, shard_len):
+    words = jnp.asarray(data)
+    parity, digests = codec_step.encode_and_hash_words_digest(
+        words, parity_shards, shard_len
+    )
+    return parity, digests

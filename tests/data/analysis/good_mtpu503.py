@@ -1,0 +1,17 @@
+"""MTPU503 twin: the value is materialized through a registered drain
+seam BEFORE the boundary — the closure captures host data, so the
+worker thread never syncs the device."""
+
+from minio_tpu.ops import codec_step
+
+
+def put_async(pool, words, parity_shards, shard_len):
+    # encode_and_hash is a registered drain seam: its returns are host
+    parity, digests = codec_step.encode_and_hash(
+        words, parity_shards, shard_len
+    )
+
+    def _work():
+        return parity.sum()
+
+    pool.submit("stripe-0", _work)

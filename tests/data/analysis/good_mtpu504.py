@@ -1,0 +1,16 @@
+"""MTPU504 twin: the same blocking helper, but shipped across a
+worker-pool boundary — exactly the sanctioned sync-def bridge.  The
+pool edge cuts loop-reachability, so the sleep happens on a worker
+thread, never on the loop."""
+
+import asyncio
+import time
+
+
+def _fsync_meta(path):
+    time.sleep(0.01)
+
+
+async def handle_put(pool, conn, path):
+    pool.submit("meta", _fsync_meta)
+    await asyncio.sleep(0)

@@ -1,0 +1,21 @@
+"""MTPU505 twin: a jit decorator with no donation and a
+register_kernel call with no donate_argnums — nothing for the registry
+to drift against."""
+
+import functools
+
+import jax
+
+from minio_tpu.parallel import rules
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def fused_probe(words, parity_shards):
+    return words
+
+
+def _build(words):
+    return words
+
+
+rules.register_kernel("probe_kernel", _build)

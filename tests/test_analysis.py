@@ -123,30 +123,11 @@ def test_tree_contracts_clean(contract_findings):
 
 # -- contract registry covers every jitted entry point ------------------
 
-# the entry points the seed tree ships; introspection must find at
-# LEAST these (a rename or deletion shows up as a diff here, a new
+# the entry points the tree ships, now registered in kernel_contracts
+# (the deviceflow pass reads the same table); introspection must find
+# at LEAST these (a rename or deletion shows up as a diff here, a new
 # kernel shows up as MTPU204 in the contract run).
-KNOWN_ENTRY_POINTS = {
-    ("rs", "_encode_jit"),
-    ("rs", "_reconstruct_jit"),
-    ("rs", "_reconstruct_static_jit"),
-    ("rs_pallas", "_matmul_words_jit"),
-    ("rs_pallas", "_mxu_matmul_jit"),
-    ("rs_pallas", "encode_hash_fused"),
-    ("rs_pallas", "encode_pack_fused"),
-    ("rs_pallas", "verify_reconstruct_fused"),
-    ("codec_step", "encode_and_hash_words"),
-    ("codec_step", "encode_words_fused1"),
-    ("codec_step", "verify_and_reconstruct_words"),
-    ("codec_step", "encode_and_hash_words_digest"),
-    ("codec_step", "group_flags"),
-    ("codec_step", "pack_nonzero_groups"),
-    ("codec_step", "verify_hashes_words"),
-    ("codec_step", "reconstruct_words_batch"),
-    ("codec_step", "encode_throughput_probe"),
-    ("codec_step", "reconstruct_throughput_probe"),
-    ("codec_step", "verify_throughput_probe"),
-}
+KNOWN_ENTRY_POINTS = kernel_contracts.KNOWN_ENTRY_POINTS
 
 
 def test_introspection_finds_the_known_entry_points():
@@ -803,6 +784,292 @@ def test_lockorder_install_restores_module_globals():
     assert local_locker.threading is real_threading
 
 
+# -- MTPU5xx: interprocedural device-dataflow ---------------------------
+#
+# The deviceflow pass runs on PARSED sources (same trees the shared AST
+# cache serves), so fixtures and seeded canaries are analyzed in memory
+# exactly as the CLI would analyze them on disk.  MTPU504's root scope
+# is path-keyed (minio_tpu/server/), so its fixtures use rel_path
+# overrides like the MTPU107/108 ones.
+
+from minio_tpu.analysis import callgraph  # noqa: E402
+from minio_tpu.analysis.astcache import CACHE, parse_source  # noqa: E402
+from minio_tpu.analysis.deviceflow import analyze_sources  # noqa: E402
+
+DEVICEFLOW_REL_OVERRIDE = {
+    "bad_mtpu504.py": "minio_tpu/server/bad_mtpu504.py",
+    "good_mtpu504.py": "minio_tpu/server/good_mtpu504.py",
+}
+
+
+def _deviceflow_fixture(name, *, rel_path=None):
+    """Deviceflow-analyze one fixture, noqa-filtered as the CLI would."""
+    lines = _fixture_lines(name)
+    rel = rel_path or DEVICEFLOW_REL_OVERRIDE.get(
+        name, f"tests/data/analysis/{name}"
+    )
+    text = "\n".join(lines) + "\n"
+    rep = analyze_sources({rel: parse_source(rel, text)})
+    return filter_suppressed(rep.findings, {rel: lines})
+
+
+@pytest.mark.parametrize(
+    "name", [f"bad_mtpu50{i}.py" for i in range(1, 6)]
+)
+def test_bad_deviceflow_fixture_exact_findings(name):
+    expected = _expected_markers(name)
+    assert expected, f"{name} declares no VIOLATION markers"
+    got = {(f.rule, f.line) for f in _deviceflow_fixture(name)}
+    assert got == expected
+
+
+@pytest.mark.parametrize(
+    "name", [f"good_mtpu50{i}.py" for i in range(1, 6)]
+)
+def test_good_deviceflow_fixture_clean(name):
+    found = _deviceflow_fixture(name)
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_tree_deviceflow_clean():
+    """minio_tpu/ carries zero unsuppressed deviceflow findings."""
+    found = analysis.run_deviceflow()
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def _read_tree_source(rel):
+    with open(os.path.join(analysis.REPO_ROOT, rel), encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_mtpu501_fires_on_seeded_codec_step_canary():
+    """Canary: a copy of the REAL ops/codec_step.py that re-reads a
+    donated buffer is caught, with exact rule id and line — the same
+    discipline as the MTPU108 aio.py canary."""
+    rel = "minio_tpu/ops/codec_step.py"
+    src = _read_tree_source(rel)
+    injected = (
+        "\n\ndef _canary_reuse(words, parity_shards, shard_len):\n"
+        "    parity, digests = encode_and_hash_words_digest(\n"
+        "        words, parity_shards, shard_len\n"
+        "    )\n"
+        "    return words.sum(), parity\n"
+    )
+    seeded = src + injected
+    # the pristine copy is clean ...
+    clean = analyze_sources({rel: parse_source(rel, src)}).findings
+    assert [f for f in clean if f.rule == "MTPU501"] == []
+    # ... the mutated copy fires exactly where the re-read happens
+    found = analyze_sources({rel: parse_source(rel, seeded)}).findings
+    expect_line = seeded.splitlines().index(
+        "    return words.sum(), parity"
+    ) + 1
+    assert {(f.rule, f.line) for f in found if f.rule == "MTPU501"} == {
+        ("MTPU501", expect_line)
+    }
+
+
+def test_mtpu502_fires_on_seeded_backend_canary():
+    """Canary: a copy of the REAL codec/backend.py that drains parity
+    outside the registered seams is caught, exact rule id and line."""
+    rel = "minio_tpu/codec/backend.py"
+    src = _read_tree_source(rel)
+    injected = (
+        "\n\ndef _canary_peek(words, parity_shards, shard_len):\n"
+        "    parity_w, digests = codec_step.encode_and_hash_words_digest(\n"
+        "        words, parity_shards, shard_len\n"
+        "    )\n"
+        "    return np.asarray(parity_w)\n"
+    )
+    seeded = src + injected
+    clean = analyze_sources({rel: parse_source(rel, src)}).findings
+    assert [f for f in clean if f.rule == "MTPU502"] == []
+    found = analyze_sources({rel: parse_source(rel, seeded)}).findings
+    expect_line = seeded.splitlines().index(
+        "    return np.asarray(parity_w)"
+    ) + 1
+    assert {(f.rule, f.line) for f in found if f.rule == "MTPU502"} == {
+        ("MTPU502", expect_line)
+    }
+
+
+# -- call-graph coverage: introspection-closed, like MTPU204 ------------
+
+
+@pytest.fixture(scope="module")
+def tree_graph():
+    sources = CACHE.load(analysis.iter_py_files())
+    return sources, callgraph.build(sources)
+
+
+def test_callgraph_resolves_every_registered_entry_point(tree_graph):
+    """Every jitted entry point in kernel_contracts.KNOWN_ENTRY_POINTS
+    resolves to a def node in the call graph (registry vs graph, the
+    same closure discipline the MTPU204 coverage test applies)."""
+    _, graph = tree_graph
+    missing = [
+        (mod, name)
+        for mod, name in sorted(kernel_contracts.KNOWN_ENTRY_POINTS)
+        if graph.resolve_short(mod, name) is None
+    ]
+    assert missing == []
+
+
+def test_callgraph_records_every_boundary_site(tree_graph):
+    """Introspection-closed: every call in server/ and codec/erasure.py
+    that the boundary classifier recognizes has a recorded boundary
+    edge at its exact line — no submit/bridge site goes unrecorded."""
+    import ast as _ast
+
+    sources, graph = tree_graph
+    recorded = {(e.rel_path, e.line) for e in graph.boundary_edges()}
+    checked = 0
+    for rel, mod in sources.items():
+        if not (
+            rel.startswith("minio_tpu/server/")
+            or rel == "minio_tpu/codec/erasure.py"
+        ):
+            continue
+        assert mod.tree is not None
+        for node in _ast.walk(mod.tree):
+            if isinstance(node, _ast.Call) and callgraph.boundary_kind(
+                node
+            ):
+                assert (rel, node.lineno) in recorded, (
+                    f"boundary site {rel}:{node.lineno} unrecorded"
+                )
+                checked += 1
+    # the seed tree ships pool submits in erasure.py and both bridge
+    # directions in server/aio.py; an empty walk means scope rot
+    assert checked >= 10
+    kinds = {e.boundary for e in graph.boundary_edges()}
+    assert {"pool", "loop-bridge", "loop-call", "thread"} <= kinds
+
+
+def test_callgraph_stats_shape(tree_graph):
+    _, graph = tree_graph
+    stats = graph.stats()
+    assert set(stats) == {"nodes", "edges", "boundary_edges", "seconds"}
+    assert stats["nodes"] > 1000
+    assert stats["edges"] > stats["boundary_edges"] > 0
+
+
+# -- --changed-only soundness: reverse-dependency closure ---------------
+
+
+def test_reverse_closure_retriggers_caller_on_helper_edit():
+    """Editing a CALLEE must re-trigger deviceflow on its callers: the
+    helper below starts host-pure (caller clean), then is edited to
+    return a device value (caller's np.asarray becomes an MTPU502).
+    The reverse-dependency closure of {helper} must contain the caller,
+    so --changed-only reports the caller's finding; naive per-file
+    gating would silently skip it."""
+    helper_rel = "minio_tpu/cache/df_helper.py"
+    caller_rel = "minio_tpu/cache/df_caller.py"
+    caller_src = (
+        "import numpy as np\n"
+        "from minio_tpu.cache.df_helper import make\n"
+        "\n"
+        "def use():\n"
+        "    return np.asarray(make(3))\n"
+    )
+    helper_v1 = "def make(x):\n    return x\n"
+    helper_v2 = (
+        "import jax.numpy as jnp\n"
+        "\n"
+        "def make(x):\n"
+        "    return jnp.zeros((4,))\n"
+    )
+
+    def run(helper_src):
+        sources = {
+            helper_rel: parse_source(helper_rel, helper_src),
+            caller_rel: parse_source(caller_rel, caller_src),
+        }
+        return analyze_sources(sources)
+
+    before = run(helper_v1)
+    assert [f for f in before.findings if f.rule == "MTPU502"] == []
+
+    after = run(helper_v2)
+    caller_hits = [
+        f
+        for f in after.findings
+        if f.rule == "MTPU502" and f.path == caller_rel
+    ]
+    assert len(caller_hits) == 1 and caller_hits[0].line == 5
+
+    # the sound --changed-only trigger set: helper edit pulls in caller
+    closure = after.graph.reverse_file_closure({helper_rel})
+    assert caller_rel in closure
+    restricted = [f for f in after.findings if f.path in closure]
+    assert caller_hits[0] in restricted
+    # naive per-file gating would have dropped it
+    assert caller_hits[0].path not in {helper_rel}
+
+
+def test_deviceflow_suppression_and_staleness_audit():
+    """# noqa: MTPU501 silences a real finding; a stale MTPU5xx noqa is
+    itself flagged by the pass's own MTPU106 audit."""
+    lines = _fixture_lines("bad_mtpu501.py")
+    rel = "tests/data/analysis/bad_mtpu501.py"
+    idx = next(
+        i for i, ln in enumerate(lines) if "VIOLATION: MTPU501" in ln
+    )
+    suppressed = list(lines)
+    suppressed[idx] = suppressed[idx].split("#")[0].rstrip()
+    suppressed[idx] += "  # noqa: MTPU501"
+    text = "\n".join(suppressed) + "\n"
+    rep = analyze_sources({rel: parse_source(rel, text)})
+    from minio_tpu.analysis.findings import unused_suppressions as _aud
+
+    audited = rep.findings + _aud(
+        rel, text, rep.findings, prefixes=("MTPU5",)
+    )
+    found = filter_suppressed(audited, {rel: suppressed})
+    assert found == [], "\n".join(f.render() for f in found)
+
+    # stale: an MTPU5xx noqa on a code line where nothing fires (the
+    # audit tokenizes, so it must sit on a real code line, not in the
+    # docstring)
+    stale = list(lines)
+    stale_idx = next(
+        i for i, ln in enumerate(stale) if ln.startswith("import ")
+    )
+    stale[stale_idx] += "  # noqa: MTPU502"
+    stale_text = "\n".join(stale) + "\n"
+    rep2 = analyze_sources({rel: parse_source(rel, stale_text)})
+    audited2 = rep2.findings + _aud(
+        rel, stale_text, rep2.findings, prefixes=("MTPU5",)
+    )
+    found2 = filter_suppressed(audited2, {rel: stale})
+    assert any(
+        f.rule == "MTPU106" and f.line == stale_idx + 1 for f in found2
+    ), "\n".join(f.render() for f in found2)
+
+
+def test_astcache_reparses_only_on_mtime_change(tmp_path):
+    """The shared AST cache is (mtime, size)-keyed: same stamp serves
+    the same object, an edit re-parses."""
+    import os as _os
+
+    rel_dir = tmp_path
+    target = rel_dir / "mod.py"
+    target.write_text("x = 1\n")
+    from minio_tpu.analysis.astcache import AstCache
+
+    cache = AstCache()
+    rel = os.path.relpath(str(target), analysis.REPO_ROOT)
+    first = cache.get(rel)
+    again = cache.get(rel)
+    assert first is again
+    target.write_text("x = 2\n")
+    _os.utime(str(target), ns=(1, 1))  # force a distinct stamp
+    third = cache.get(rel)
+    assert third is not first
+    assert third.text == "x = 2\n"
+
+
 # -- CLI contract -------------------------------------------------------
 
 
@@ -850,18 +1117,47 @@ def test_cli_json_is_machine_readable_and_stable():
         "--skip",
         "contracts",
         "locks",
+        "deviceflow",
     )
     r1 = _run_cli(*args)
     r2 = _run_cli(*args)
     assert r1.returncode == 1
-    assert r1.stdout == r2.stdout, "JSON output must be deterministic"
-    data = json.loads(r1.stdout)
+    d1, d2 = json.loads(r1.stdout), json.loads(r2.stdout)
+    assert set(d1) == {"findings", "passes", "callgraph"}
+    # findings are deterministic; pass timings are wall-clock and not
+    data = d1["findings"]
+    assert data == d2["findings"], "findings must be deterministic"
     assert data == sorted(
         data,
         key=lambda d: (d["path"], d["line"], d["rule"], d["message"]),
     )
     assert {d["rule"] for d in data} == {"MTPU101", "MTPU104"}
     assert set(data[0]) == {"rule", "path", "line", "message"}
+    assert set(d1["passes"]) == {"lint", "abi"}
+    assert d1["callgraph"] is None  # deviceflow skipped
+
+
+def test_cli_json_reports_timings_and_callgraph_stats():
+    """--json carries per-pass wall seconds and the call-graph block
+    when the deviceflow pass runs."""
+    r = _run_cli(
+        "--json",
+        "--paths",
+        "tests/data/analysis/good_mtpu501.py",
+        "--skip",
+        "contracts",
+        "locks",
+        "abi",
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    data = json.loads(r.stdout)
+    assert data["findings"] == []
+    assert set(data["passes"]) == {"lint", "deviceflow"}
+    for secs in data["passes"].values():
+        assert isinstance(secs, float) and secs >= 0.0
+    cg = data["callgraph"]
+    assert set(cg) == {"nodes", "edges", "boundary_edges", "seconds"}
+    assert cg["nodes"] >= 1 and cg["seconds"] >= 0.0
 
 
 def test_cli_list_rules():
@@ -872,7 +1168,7 @@ def test_cli_list_rules():
 
 
 def test_cli_skip_covers_the_abi_pass():
-    r = _run_cli("--skip", "abi", "contracts", "locks")
+    r = _run_cli("--skip", "abi", "contracts", "locks", "deviceflow")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "[lint]" in r.stderr
 
@@ -885,7 +1181,14 @@ def test_cli_changed_only_exits_zero():
 
 @pytest.mark.slow
 def test_cli_full_run_is_clean():
-    """All four passes through the real CLI (what CI would run)."""
+    """All five passes through the real CLI (what CI would run), and
+    the full run stays inside the 30s analyzer budget."""
+    t0 = time.monotonic()
     r = _run_cli()
+    wall = time.monotonic() - t0
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "0 finding(s) [lint, abi, contracts, locks]" in r.stderr
+    assert (
+        "0 finding(s) [lint, abi, contracts, locks, deviceflow]"
+        in r.stderr
+    )
+    assert wall < 30.0, f"full analyzer run took {wall:.1f}s (budget 30s)"

@@ -414,7 +414,12 @@ def test_tenant_admission_sheds_503(leakcheck, tmp_path):
         results = {}
 
         def fetch():
-            results["a"] = S3Client(srv.endpoint).get_object("tenantb", "slow")
+            # the setup PUT's tenant slot releases a hair after its
+            # response flushes, so this GET can shed transiently too —
+            # retry until it actually occupies the slot and parks
+            results["a"] = _retry_503(
+                S3Client(srv.endpoint).get_object, "tenantb", "slow"
+            )
 
         t = threading.Thread(target=fetch)
         t.start()
