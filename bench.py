@@ -207,8 +207,9 @@ def bench_cpu_baseline() -> dict:
 
 
 def bench_codec_micro() -> dict:
-    """Codec microbench (--codec-micro): CPU-native fused-vs-split plus
-    the round-14 one-kernel device variant sweep (BENCH_r14 schema).
+    """Codec microbench (--codec-micro): CPU-native fused-vs-split, the
+    round-14 one-kernel device variant sweep, and the round-18
+    transfer/compute overlap modes (BENCH_r18 schema).
 
     Section "native" (round 7, unchanged): one (64, 8, 128 KiB) batch -
     64 MiB of data, EC 8+4 - encoded both ways on the bare CpuBackend.
@@ -232,6 +233,12 @@ def bench_codec_micro() -> dict:
     TpuBackend seam per mode and records KERNEL_STATS device_passes +
     per-plane D2H bytes: fused1 PUT must be exactly one launch (legacy
     three) with digest-only eager readback.
+
+    Section "transfer_overlap" (round 18) sweeps
+    MINIO_TPU_CODEC_OVERLAP=off|async|pipeline through the same seam:
+    every overlapped mode is bit-identity gated against "off" before
+    timing, overlapped modes must open overlap windows, and pipeline
+    mode must stay at one kernel launch per direction.
     """
     import os
 
@@ -448,12 +455,152 @@ def bench_codec_micro() -> dict:
     assert accounting["legacy"]["put_total_launches"] >= 3
     assert accounting["fused1"]["get_total_launches"] == 1
 
+    # -- round 18: transfer/compute overlap sweep -----------------------
+    # Drive the real TpuBackend digest seam per MINIO_TPU_CODEC_OVERLAP
+    # mode, PUT and GET.  Bit-identity against "off" is a hard gate
+    # BEFORE any timing; KERNEL_STATS must prove overlap windows opened
+    # in the overlapped modes while the pipeline mode stays at exactly
+    # one launch per direction with digest-only eager D2H.  On a host
+    # CPU the portable async mode pays real slicing/dispatch overhead
+    # with nothing to hide it behind - the bandwidth win is the TPU
+    # story (DMA engines running under the compute), so the numbers
+    # here are a cost ceiling, not the claim.
+    ob, okk, omm = 2, 4, 2
+    on_ = okk + omm
+    oL = 4 * 4 * rs_pallas._TW  # 64 KiB shards -> w = 4*_TW words
+    odata = rng.integers(0, 256, (ob, okk, oL), dtype=np.uint8)
+    odata[0, 1] = 0  # keep the pack leg live across sub-chunks
+    ogib = odata.nbytes / 2**30
+    saved = {
+        key: os.environ.get(key)
+        for key in ("MINIO_TPU_CODEC_KERNEL", "MINIO_MESH",
+                    "MINIO_TPU_DEVICE_COMPRESS", "MINIO_TPU_CODEC_OVERLAP",
+                    "MINIO_TPU_CODEC_SUBCHUNK_KB",
+                    "MINIO_TPU_CODEC_INTERPRET")
+    }
+    on_tpu = jax.default_backend() == "tpu"
+    overlap_section = {
+        "ec": f"{okk}+{omm}",
+        "batch": ob,
+        "shard_len": oL,
+        "data_mib": round(odata.nbytes / 2**20, 2),
+        "subchunk_kb": 16,
+        "modes": {},
+    }
+    try:
+        os.environ["MINIO_MESH"] = "0"
+        os.environ["MINIO_TPU_DEVICE_COMPRESS"] = "on"
+        os.environ["MINIO_TPU_CODEC_KERNEL"] = "fused1"
+        os.environ["MINIO_TPU_CODEC_SUBCHUNK_KB"] = "16"  # S=4 sub-chunks
+
+        def _overlap_drive(mode):
+            os.environ["MINIO_TPU_CODEC_OVERLAP"] = mode
+            if mode == "pipeline" and not on_tpu:
+                os.environ["MINIO_TPU_CODEC_INTERPRET"] = "1"
+            else:
+                os.environ.pop("MINIO_TPU_CODEC_INTERPRET", None)
+            reset_backend()
+            tb = TpuBackend()
+
+            def put():
+                dig_, ref_ = tb.encode_digest_end(
+                    tb.encode_digest_begin(odata.copy(), omm)
+                )
+                par_ = ref_.drain()
+                ref_.release()
+                return dig_, par_
+
+            def get(dig_, par_):
+                shards_ = np.concatenate([odata, par_], axis=1)
+                return tb.reconstruct_and_verify(
+                    shards_, dig_, (True,) * on_, okk, omm
+                )
+
+            KERNEL_STATS.reset()
+            dig, ref = tb.encode_digest_end(
+                tb.encode_digest_begin(odata.copy(), omm)
+            )
+            planes_pre = {
+                d_["plane"]: d_["bytes"]
+                for d_ in KERNEL_STATS.snapshot()["d2h"]
+            }
+            par = ref.drain()
+            ref.release()
+            put_snap = KERNEL_STATS.snapshot()
+            KERNEL_STATS.reset()
+            got, ok = get(dig, par)
+            get_snap = KERNEL_STATS.snapshot()
+            return (dig, par, got, ok, planes_pre, put_snap, get_snap,
+                    put, get)
+
+        base = None
+        for mode in ("off", "async", "pipeline"):
+            (dig, par, got, ok, planes_pre, put_snap, get_snap,
+             put, get) = _overlap_drive(mode)
+            # hard bit-identity gate BEFORE any timing
+            assert bool(np.all(ok)), mode
+            assert np.array_equal(got, odata), mode
+            if base is None:
+                base = (dig, par)
+            else:
+                assert np.array_equal(dig, base[0]), mode
+                assert np.array_equal(par, base[1]), mode
+            ow_put = put_snap["overlap_windows"].get("put", 0)
+            ow_get = get_snap["overlap_windows"].get("get", 0)
+            pp = dict(put_snap["device_passes"])
+            gp = dict(get_snap["device_passes"])
+            if mode == "off":
+                assert ow_put == 0 and ow_get == 0, (ow_put, ow_get)
+            else:
+                assert ow_put > 0, mode
+                assert ow_get > 0, mode
+            if mode == "pipeline":
+                # still ONE kernel launch per direction: the overlap
+                # lives inside the Pallas grid, not in extra dispatches
+                assert sum(pp.values()) == 1, pp
+                assert sum(gp.values()) == 1, gp
+                assert planes_pre.get("parity", 0) == 0, planes_pre
+            entry = {
+                "overlap_windows": {"put": ow_put, "get": ow_get},
+                "put_launches": sum(pp.values()),
+                "get_launches": sum(gp.values()),
+                "h2d_data_bytes_put": next(
+                    (d_["bytes"] for d_ in put_snap["h2d"]
+                     if d_["plane"] == "data"), 0
+                ),
+                "digest_only_before_drain":
+                    planes_pre.get("parity", 0) == 0,
+            }
+            if mode == "pipeline" and not on_tpu:
+                # interpret mode is a correctness gate, not a fast path:
+                # no throughput claim off-TPU
+                entry["interpret"] = True
+            else:
+                t_put, sp_put = _time(put, reps=3)
+                dig_t, par_t = put()
+                t_get, sp_get = _time(
+                    lambda: get(dig_t, par_t), reps=3
+                )
+                entry["put_gibps"] = round(ogib / t_put, 3)
+                entry["get_gibps"] = round(ogib / t_get, 3)
+                entry["rel_spread"] = round(max(sp_put, sp_get), 3)
+            overlap_section["modes"][mode] = entry
+        overlap_section["bit_identical_all_modes"] = True  # hard-gated
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        reset_backend()
+
     return {
         "metric": "codec micro (native fused-vs-split + one-kernel "
-        "variant sweep, bit-identity gated)",
+        "variant sweep + transfer-overlap modes, bit-identity gated)",
         "native": native_section,
         "kernel_variants": variants,
         "pass_accounting": accounting,
+        "transfer_overlap": overlap_section,
     }
 
 

@@ -86,10 +86,14 @@ KNOWN_ENTRY_POINTS = {
     ("rs_pallas", "encode_hash_fused"),
     ("rs_pallas", "encode_pack_fused"),
     ("rs_pallas", "verify_reconstruct_fused"),
+    ("rs_pallas", "encode_pack_pipelined"),
+    ("rs_pallas", "verify_reconstruct_pipelined"),
     ("codec_step", "encode_and_hash_words"),
     ("codec_step", "encode_and_hash_words_digest"),
     ("codec_step", "encode_words_fused1"),
     ("codec_step", "verify_and_reconstruct_words"),
+    ("codec_step", "encode_subchunk_words"),
+    ("codec_step", "verify_reconstruct_subchunk_words"),
     ("codec_step", "group_flags"),
     ("codec_step", "pack_nonzero_groups"),
     ("codec_step", "verify_hashes_words"),
@@ -112,6 +116,11 @@ KNOWN_ENTRY_POINTS = {
 DONATING_ENTRY_POINTS = {
     ("codec_step", "encode_and_hash_words_digest"): (0,),
     ("codec_step", "encode_words_fused1"): (0,),
+    # the async overlap sub-chunk chain donates BOTH the staging chunk
+    # (dies into the parity allocation) and the ping-pong hash
+    # accumulator (threads through the chunk chain)
+    ("codec_step", "encode_subchunk_words"): (0, 1),
+    ("codec_step", "verify_reconstruct_subchunk_words"): (0, 1),
 }
 
 # Mesh kernel kinds registered with the rules.py compile seam that
@@ -141,6 +150,11 @@ DRAIN_SEAMS = {
         "reconstruct_and_verify",
         "verify",
         "digest",
+        # sub-chunk overlap pipeline (MINIO_TPU_CODEC_OVERLAP=async):
+        # the chunked parity-plane drain and the GET-side chain that
+        # drains chunk s D2H while chunk s+1 computes
+        "_drain_chunks",
+        "_drain_vr_subchunks",
     ),
     "minio_tpu/s3select/device.py": (
         # candidate row bytes are the only payload that crosses D2H,
@@ -620,6 +634,81 @@ def run() -> "list[Finding]":
             except Exception as e:
                 c.fail(e)
 
+    # ---- codec_step.py: async-overlap sub-chunk twins -------------------
+    #
+    # The MINIO_TPU_CODEC_OVERLAP=async chain: per-chunk parity/verify
+    # passes threading a donated (B, n, 8) hash-partial accumulator.
+    # Contracts run each entry as a mid-chain link (finalize=False) and
+    # as the chain tail (finalize=True) — shapes must agree so the
+    # backend's ping-pong reassignment stays well-typed, and the chunk
+    # width grid includes a NON-dividing width (the ragged tail chunk
+    # compiles as its own program).
+
+    covers("codec_step", "encode_subchunk_words")
+    c = ctx(codec_step.encode_subchunk_words, "minio_tpu/ops/codec_step.py")
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        for cw in (w, w // 2 if w // 2 % 8 == 0 else w, 8):
+            for group in (0, _GROUP):
+                if group and cw % group:
+                    continue
+                gc = cw // group if group else 0
+                for fin in (False, True):
+                    c.config = (
+                        cfg_str(k, m, L)
+                        + f" [cw={cw}, group={group}, finalize={fin}]"
+                    )
+                    try:
+                        parity, acc, flags, packed = (
+                            codec_step.encode_subchunk_words.eval_shape(
+                                S((_BATCH, k, cw), u32),
+                                S((_BATCH, n, 8), u32),
+                                S((), u32),
+                                m, L, group, fin,
+                            )
+                        )
+                        c.shape(parity, (_BATCH, m, cw), "chunk parity")
+                        c.dtype(parity, "uint32", "chunk parity")
+                        c.shape(acc, (_BATCH, n, 8), "chunk partials")
+                        c.dtype(acc, "uint32", "chunk partials")
+                        c.shape(flags, (_BATCH, m, gc), "chunk flags")
+                        c.dtype(flags, "bool", "chunk flags")
+                        c.shape(packed, (_BATCH, m, cw), "chunk packed")
+                        c.dtype(packed, "uint32", "chunk packed")
+                    except Exception as e:
+                        c.fail(e)
+
+    covers("codec_step", "verify_reconstruct_subchunk_words")
+    c = ctx(
+        codec_step.verify_reconstruct_subchunk_words,
+        "minio_tpu/ops/codec_step.py",
+    )
+    for k, m, L in CONFIG_GRID:
+        w, n = L // 4, k + m
+        present = (False,) * m + (True,) * (n - m)
+        for cw in (w, 8):
+            for fin in (False, True):
+                c.config = cfg_str(k, m, L) + f" [cw={cw}, finalize={fin}]"
+                try:
+                    data, acc, ok = (
+                        codec_step
+                        .verify_reconstruct_subchunk_words.eval_shape(
+                            S((_BATCH, n, cw), u32),
+                            S((_BATCH, n, 8), u32),
+                            S((_BATCH, n, 8), u32),
+                            S((), u32),
+                            present, k, m, L, fin,
+                        )
+                    )
+                    c.shape(data, (_BATCH, k, cw), "chunk data words")
+                    c.dtype(data, "uint32", "chunk data words")
+                    c.shape(acc, (_BATCH, n, 8), "chunk partials")
+                    c.dtype(acc, "uint32", "chunk partials")
+                    c.shape(ok, (_BATCH, n), "chunk ok mask")
+                    c.dtype(ok, "bool", "chunk ok mask")
+                except Exception as e:
+                    c.fail(e)
+
     # ---- select_step.py: S3 Select scan kernels -------------------------
     #
     # SWAR flag-words are uint64, so every contract evaluates under
@@ -851,6 +940,64 @@ def run() -> "list[Finding]":
                 c.dtype(data, "uint32", "fused GET data words")
                 c.shape(hacc, (_BATCH, n, 8), "fused GET hash partials")
                 c.dtype(hacc, "uint32", "fused GET hash partials")
+            except Exception as e:
+                c.fail(e)
+
+    # ---- rs_pallas.py: manual-DMA pipelined twins -----------------------
+    #
+    # MINIO_TPU_CODEC_OVERLAP=pipeline swaps these in for the fused
+    # kernels above — identical output contracts by construction (the
+    # runtime bit-identity tests assert values; here shapes/dtypes),
+    # checked over both formulations like their serialized twins.
+
+    covers("rs_pallas", "encode_pack_pipelined")
+    c = ctx(rs_pallas.encode_pack_pipelined, "minio_tpu/ops/rs_pallas.py")
+    for k, m, L in FUSED_GRID:
+        w, n = L // 4, k + m
+        for group in (0, 256):
+            g = w // group if group else 0
+            for formulation in ("swar", "mxu"):
+                c.config = (
+                    cfg_str(k, m, L) + f" [group={group}, {formulation}]"
+                )
+                try:
+                    parity, hacc, flags, packed = (
+                        rs_pallas.encode_pack_pipelined.eval_shape(
+                            S((_BATCH, k, w), u32), m, group,
+                            formulation, True,
+                        )
+                    )
+                    c.shape(parity, (_BATCH, m, w), "pipelined parity")
+                    c.dtype(parity, "uint32", "pipelined parity")
+                    c.shape(hacc, (_BATCH, n, 8), "pipelined partials")
+                    c.dtype(hacc, "uint32", "pipelined partials")
+                    c.shape(flags, (_BATCH, m, g), "pipelined flag words")
+                    c.dtype(flags, "uint32", "pipelined flag words")
+                    c.shape(packed, (_BATCH, m, w), "pipelined packed")
+                    c.dtype(packed, "uint32", "pipelined packed")
+                except Exception as e:
+                    c.fail(e)
+
+    covers("rs_pallas", "verify_reconstruct_pipelined")
+    c = ctx(
+        rs_pallas.verify_reconstruct_pipelined, "minio_tpu/ops/rs_pallas.py"
+    )
+    for k, m, L in FUSED_GRID:
+        w, n = L // 4, k + m
+        idx = tuple(range(m, n))[:k]
+        for formulation in ("swar", "mxu"):
+            c.config = cfg_str(k, m, L) + f" [{formulation}]"
+            try:
+                data, hacc = (
+                    rs_pallas.verify_reconstruct_pipelined.eval_shape(
+                        S((_BATCH, n, w), u32), idx, k, m,
+                        formulation, True,
+                    )
+                )
+                c.shape(data, (_BATCH, k, w), "pipelined GET data words")
+                c.dtype(data, "uint32", "pipelined GET data words")
+                c.shape(hacc, (_BATCH, n, 8), "pipelined GET partials")
+                c.dtype(hacc, "uint32", "pipelined GET partials")
             except Exception as e:
                 c.fail(e)
 

@@ -50,6 +50,60 @@ def _record_pass(kernel: str) -> None:
     KERNEL_STATS.record_pass(kernel)
 
 
+def _record_h2d(plane: str, nbytes: int) -> None:
+    """Account one host->device codec staging transfer (plane =
+    data|parity), the H2D twin of _record_d2h."""
+    from .telemetry import KERNEL_STATS
+
+    KERNEL_STATS.record_h2d(plane, int(nbytes))
+
+
+def _record_overlap(plane: str, windows: int) -> None:
+    """Account completed overlap windows (plane = put|get): iterations
+    where a transfer provably ran concurrently with compute — the
+    snapshot-level evidence the MINIO_TPU_CODEC_OVERLAP pipeline
+    engaged (bench --codec-micro gates on this being > 0)."""
+    from .telemetry import KERNEL_STATS
+
+    KERNEL_STATS.record_overlap_windows(plane, int(windows))
+
+
+# Ping-pong staging ledger for the async sub-chunk pipeline: while a
+# batch is between encode_digest_begin and _end, TWO sub-chunk staging
+# buffers are live on device (the one computing and the one prefetching)
+# on top of the parity planes the ParityPlaneCache already accounts.
+# Posted to the shared device-byte budget so cache admission sees the
+# real headroom (cache/allocator.py).
+_staging_bytes = 0
+
+
+def _stage_reserve(nbytes: int) -> int:
+    global _staging_bytes
+    nbytes = int(nbytes)
+    with _lock:
+        _staging_bytes += nbytes
+        total = _staging_bytes
+    _post_staging(total)
+    return nbytes
+
+
+def _stage_release(nbytes: int) -> None:
+    global _staging_bytes
+    with _lock:
+        _staging_bytes = max(0, _staging_bytes - int(nbytes))
+        total = _staging_bytes
+    _post_staging(total)
+
+
+def _post_staging(total: int) -> None:
+    try:
+        from ..cache.allocator import device_budget
+
+        device_budget().set_usage("codec_staging", total)
+    except Exception as exc:  # noqa: BLE001 - must never fail I/O
+        _log.debug("staging budget accounting failed: %s", exc)
+
+
 # ---------------------------------------------------------------------------
 # Device-resident parity plane: refs + the bounded write-back cache
 # ---------------------------------------------------------------------------
@@ -300,6 +354,95 @@ class _DeviceParityRef:
         parity = np.asarray(parity_w)
         _record_d2h("parity", parity.nbytes)
         return codec_step.host_words_to_bytes(parity)
+
+
+class _SubchunkParityRef:
+    """One batch's device-resident parity plane held as the S sub-chunk
+    arrays the async overlap pipeline produced (splits along the
+    stripe-length axis, MINIO_TPU_CODEC_OVERLAP=async).
+
+    Same contract as _DeviceParityRef: ``drain()`` is the single
+    memoized D2H seam shared by the m parity writers, ``release()``
+    drops the plane without the transfer, and the ParityPlaneCache
+    accounts every live device plane — parity AND the packed twin when
+    the pack leg ran — so write-back pressure stays honest about the
+    doubled footprint.
+    """
+
+    __slots__ = (
+        "_lk",
+        "_cache",
+        "_parity",
+        "_flags",
+        "_packed",
+        "_group",
+        "_host",
+        "nbytes",
+    )
+
+    def __init__(
+        self,
+        cache: ParityPlaneCache,
+        parity_chunks,
+        flags=None,
+        packed=None,
+        group: int = 0,
+    ):
+        self._lk = threading.Lock()
+        self._cache = cache
+        self._parity = list(parity_chunks)
+        self._flags = list(flags) if flags else None
+        self._packed = list(packed) if packed else None
+        self._group = int(group)
+        self._host: "np.ndarray | None" = None
+        plane = sum(
+            int(p.shape[0]) * int(p.shape[1]) * int(p.shape[2]) * 4
+            for p in self._parity
+        )
+        self.nbytes = plane * (2 if self._packed is not None else 1)
+        cache.add(self)
+
+    def drain(self) -> np.ndarray:
+        """(B, m, L) uint8 parity bytes, materialized at most once."""
+        with self._lk:
+            if self._host is None and self._parity is not None:
+                self._host = self._drain_chunks()
+                self._parity = None
+                self._flags = None
+                self._packed = None
+                self._cache.forget(self)
+            return self._host
+
+    def release(self) -> None:
+        """Drop an undrained plane without the transfer."""
+        with self._lk:
+            if self._parity is not None:
+                self._parity = None
+                self._flags = None
+                self._packed = None
+                self._cache.forget(self)
+
+    def _drain_chunks(self) -> np.ndarray:
+        """Per-chunk D2H, concatenated along the length axis.
+
+        Each chunk reuses the fused1 drain bodies — the occupancy
+        screen picks the packed prefix or the raw plane per chunk, so
+        a sparse chunk of an otherwise dense plane still crosses the
+        bus compressed.  Chunk reads are independent async device
+        values: reading chunk s overlaps the device-side screen of
+        chunk s+1.
+        """
+        parts = [
+            (
+                _DeviceParityRef._drain_precomputed(
+                    p, self._flags[i], self._packed[i], self._group
+                )
+                if self._packed is not None
+                else _DeviceParityRef._drain_d2h(p)
+            )
+            for i, p in enumerate(self._parity)
+        ]
+        return np.concatenate(parts, axis=-1)
 
 
 _PARITY_CACHE: "ParityPlaneCache | None" = None
@@ -646,13 +789,20 @@ class TpuBackend(CodecBackend):
         data = np.ascontiguousarray(data, dtype=np.uint8)
         B, k, L = data.shape
         if self._mesh_for(B, k) is not None:
+            if codec_step.codec_overlap_mode() != "off":
+                # overlap sub-chunking would fight the mesh "seq" axis
+                # for the stripe-length dimension: warn once, fall back
+                # to the serialized (bit-identical) mesh path
+                from ..parallel import mesh as pm
+
+                pm.warn_overlap_fallback()
             # the mesh path has no device-resident cache (planes live
             # sharded across devices): compose the eager seam, still
             # async through the mesh begin/end split
             return _AsyncHandle(
                 "digest-eager", self.encode_begin(data, parity_shards)
             )
-        words = jnp.asarray(codec_step.host_bytes_to_words(data))
+        words_h = codec_step.host_bytes_to_words(data)
         if codec_step.codec_kernel_mode() == "fused1":
             from . import compress as compmod
 
@@ -668,6 +818,19 @@ class TpuBackend(CodecBackend):
                 else 0
             )
             use_pallas, interpret = codec_step.pallas_dispatch(w)
+            overlap = codec_step.codec_overlap_mode()
+            if overlap == "async":
+                handle = self._encode_subchunk_begin(
+                    words_h, parity_shards, L, group
+                )
+                if handle is not None:
+                    return handle
+                # batch too small for S >= 3 sub-chunks: serialized path
+            words = jnp.asarray(words_h)
+            _record_h2d("data", words.nbytes)
+            # pipeline mode rides the SAME entry point and pallas_call;
+            # the static only swaps in the manual-DMA kernel body
+            pipeline = overlap == "pipeline" and use_pallas
             parity_w, digests, flags_d, packed_d = (
                 codec_step.encode_words_fused1(
                     words,
@@ -677,9 +840,18 @@ class TpuBackend(CodecBackend):
                     formulation=codec_step.codec_formulation(),
                     use_pallas=use_pallas,
                     interpret=interpret,
+                    pipeline=pipeline,
                 )
             )
             _record_pass("encode_words_fused1")
+            if pipeline:
+                from ..ops import rs_pallas
+
+                nt = w // rs_pallas._TW
+                if nt > 1:
+                    # one window per in-kernel tile step whose prefetch
+                    # DMA overlapped the previous tile's compute
+                    _record_overlap("put", B * (nt - 1))
             return _AsyncHandle(
                 "digest-fused1",
                 (
@@ -690,16 +862,83 @@ class TpuBackend(CodecBackend):
                     group,
                 ),
             )
+        words = jnp.asarray(words_h)
+        _record_h2d("data", words.nbytes)
         parity_w, digests = codec_step.encode_and_hash_words_digest(
             words, parity_shards, L
         )
         _record_pass("encode_and_hash_words_digest")
         return _AsyncHandle("digest", (parity_w, digests))
 
+    def _encode_subchunk_begin(self, words_h, parity_shards, shard_len, group):
+        """MINIO_TPU_CODEC_OVERLAP=async PUT: split the stripe batch
+        along w into S sub-chunks and double-buffer them through the
+        device — chunk s+1's H2D staging (async jnp.asarray dispatch)
+        overlaps chunk s's encode pass, whose donated ping-pong
+        accumulator carries the phash256 partials; the LAST chunk
+        finalizes the digests in its own program, so the chain launches
+        S passes and nothing extra for the digest.
+
+        Returns the in-flight handle, or None when the batch is too
+        small to cut S >= 3 chunks (caller takes the serialized path).
+        """
+        import jax.numpy as jnp
+
+        from ..ops import codec_step
+        from .erasure import subchunk_words
+
+        B, k, w = words_h.shape
+        m = parity_shards
+        cw = subchunk_words(w, group if group else 8)
+        if not cw:
+            return None
+        offs = list(range(0, w, cw))
+        # ping-pong staging: two sub-chunk input buffers live at once
+        reserved = _stage_reserve(2 * B * k * cw * 4)
+        try:
+            acc = jnp.zeros((B, k + m, 8), jnp.uint32)
+            parity_c, flags_c, packed_c = [], [], []
+            for i, off in enumerate(offs):
+                end = min(off + cw, w)
+                chunk = jnp.asarray(
+                    np.ascontiguousarray(words_h[:, :, off:end])
+                )
+                _record_h2d("data", (end - off) * B * k * 4)
+                p_c, acc, f_c, pk_c = codec_step.encode_subchunk_words(
+                    chunk,
+                    acc,
+                    np.uint32(off),
+                    m,
+                    shard_len,
+                    group=group,
+                    finalize=i == len(offs) - 1,
+                )
+                _record_pass("encode_subchunk_words")
+                parity_c.append(p_c)
+                if group:
+                    flags_c.append(f_c)
+                    packed_c.append(pk_c)
+            _record_overlap("put", len(offs) - 1)
+        except BaseException:
+            _stage_release(reserved)
+            raise
+        return _AsyncHandle(
+            "digest-subchunk",
+            (
+                parity_c,
+                acc,
+                flags_c or None,
+                packed_c or None,
+                group,
+                reserved,
+            ),
+        )
+
     def encode_digest_end(self, handle):
         if not isinstance(handle, _AsyncHandle) or handle.kind not in (
             "digest",
             "digest-fused1",
+            "digest-subchunk",
             "digest-eager",
         ):
             return super().encode_digest_end(handle)
@@ -726,6 +965,31 @@ class TpuBackend(CodecBackend):
                     parity_w,
                     flags=flags_d,
                     packed=packed_d,
+                    group=group,
+                ),
+            )
+        elif handle.kind == "digest-subchunk":
+            # async-overlap twin: same digest-only eager readback; the
+            # staging ping-pong reservation drops here — the last
+            # chunk's pass has produced everything the ref holds
+            (
+                parity_c,
+                digests_d,
+                flags_c,
+                packed_c,
+                group,
+                reserved,
+            ) = handle.payload
+            digests = np.asarray(digests_d)
+            _record_d2h("data", digests.nbytes)
+            _stage_release(reserved)
+            result = (
+                digests,
+                _SubchunkParityRef(
+                    parity_plane_cache(),
+                    parity_c,
+                    flags=flags_c,
+                    packed=packed_c,
                     group=group,
                 ),
             )
@@ -806,6 +1070,8 @@ class TpuBackend(CodecBackend):
         if mesh is not None:
             from ..parallel import mesh as pm
 
+            if codec_step.codec_overlap_mode() != "off":
+                pm.warn_overlap_fallback()
             dw, ok = pm.mesh_verify_reconstruct(
                 mesh,
                 words,
@@ -817,21 +1083,41 @@ class TpuBackend(CodecBackend):
             )
             _record_pass("mesh_verify_reconstruct")
         else:
-            use_pallas, interpret = codec_step.pallas_dispatch(L // 4)
-            dw_d, ok_d = codec_step.verify_and_reconstruct_words(
-                jnp.asarray(words),
-                jnp.asarray(digests),
-                present_t,
-                data_shards,
-                parity_shards,
-                L,
-                formulation=codec_step.codec_formulation(),
-                use_pallas=use_pallas,
-                interpret=interpret,
-            )
-            _record_pass("verify_and_reconstruct_words")
-            dw = np.asarray(dw_d)
-            ok = np.asarray(ok_d)
+            overlap = codec_step.codec_overlap_mode()
+            got = None
+            if overlap == "async":
+                got = self._drain_vr_subchunks(
+                    words, digests, present_t, data_shards, parity_shards, L
+                )
+            if got is not None:
+                dw, ok = got
+            else:
+                w = L // 4
+                use_pallas, interpret = codec_step.pallas_dispatch(w)
+                pipeline = overlap == "pipeline" and use_pallas
+                words_d = jnp.asarray(words)
+                _record_h2d("data", words_d.nbytes)
+                dw_d, ok_d = codec_step.verify_and_reconstruct_words(
+                    words_d,
+                    jnp.asarray(digests),
+                    present_t,
+                    data_shards,
+                    parity_shards,
+                    L,
+                    formulation=codec_step.codec_formulation(),
+                    use_pallas=use_pallas,
+                    interpret=interpret,
+                    pipeline=pipeline,
+                )
+                _record_pass("verify_and_reconstruct_words")
+                if pipeline:
+                    from ..ops import rs_pallas
+
+                    nt = w // rs_pallas._TW
+                    if nt > 1:
+                        _record_overlap("get", B * (nt - 1))
+                dw = np.asarray(dw_d)
+                ok = np.asarray(ok_d)
         data = codec_step.host_words_to_bytes(dw)
         surv = np.nonzero(pres)[0][:data_shards]
         bad = ~ok[:, surv].all(axis=1)
@@ -843,6 +1129,73 @@ class TpuBackend(CodecBackend):
                 shards[idxs], ok[idxs], data_shards, parity_shards
             )
         return data, ok
+
+    def _drain_vr_subchunks(
+        self, words_h, digests, present, data_shards, parity_shards, shard_len
+    ):
+        """MINIO_TPU_CODEC_OVERLAP=async GET: the sub-chunked
+        verify+reconstruct chain, a registered drain seam — each
+        reconstructed chunk drains D2H here WHILE the next chunk's pass
+        runs (np.asarray of chunk s syncs only chunk s; chunks s+1.. are
+        still in flight behind it), with the digest partials threading
+        through the donated ping-pong accumulator and the LAST chunk's
+        program producing the verify mask.
+
+        Returns (data words (B, k, w), ok (B, n) bool), or None when
+        the batch is too small to cut S >= 3 chunks.
+        """
+        import jax.numpy as jnp
+
+        from ..ops import codec_step
+        from .erasure import subchunk_words
+
+        B, n, w = words_h.shape
+        cw = subchunk_words(w, 8)
+        if not cw:
+            return None
+        offs = list(range(0, w, cw))
+        reserved = _stage_reserve(2 * B * n * cw * 4)
+        try:
+            digests_d = jnp.asarray(np.asarray(digests))
+            acc = jnp.zeros((B, n, 8), jnp.uint32)
+            parts: "list[np.ndarray]" = []
+            prev = None
+            ok_d = None
+            for i, off in enumerate(offs):
+                end = min(off + cw, w)
+                chunk = jnp.asarray(
+                    np.ascontiguousarray(words_h[:, :, off:end])
+                )
+                _record_h2d("data", (end - off) * B * n * 4)
+                d_c, acc, ok_d = (
+                    codec_step.verify_reconstruct_subchunk_words(
+                        chunk,
+                        acc,
+                        digests_d,
+                        np.uint32(off),
+                        present,
+                        data_shards,
+                        parity_shards,
+                        shard_len,
+                        finalize=i == len(offs) - 1,
+                    )
+                )
+                _record_pass("verify_reconstruct_subchunk_words")
+                if prev is not None:
+                    # drain chunk i-1 while chunk i computes: this is
+                    # the D2H leg of the three-deep overlap
+                    part = np.asarray(prev)
+                    _record_d2h("data", part.nbytes)
+                    parts.append(part)
+                prev = d_c
+            part = np.asarray(prev)
+            _record_d2h("data", part.nbytes)
+            parts.append(part)
+            ok = np.asarray(ok_d)
+            _record_overlap("get", len(offs) - 1)
+        finally:
+            _stage_release(reserved)
+        return np.concatenate(parts, axis=-1), ok
 
     def digest(self, shards):
         import jax.numpy as jnp
@@ -1106,13 +1459,15 @@ def _make(name: str) -> CodecBackend:
 def reset_backend() -> None:
     """Testing aid: drop the cached backend (and the parity cache) so
     env changes take effect."""
-    global _backend, _PARITY_CACHE
+    global _backend, _PARITY_CACHE, _staging_bytes
     with _lock:
         _backend = None
         _PARITY_CACHE = None
+        _staging_bytes = 0
     try:
         from ..cache.allocator import device_budget
 
         device_budget().set_usage("parity_plane", 0)
+        device_budget().set_usage("codec_staging", 0)
     except Exception as exc:  # noqa: BLE001
         _log.debug("parity budget reset failed: %s", exc)

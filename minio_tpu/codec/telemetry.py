@@ -63,6 +63,13 @@ class KernelStats:
         # The parity-plane PUT restructure exists to drive the parity
         # row of this table to the post-ack drain band only
         self._d2h: "dict[str, list]" = {}
+        # host->device staging by plane (mirror of _d2h), and sub-chunk
+        # overlap windows by plane: a "window" is one sub-chunk whose
+        # transfer was in flight while a neighbor's compute ran — the
+        # snapshot-level proof the DMA pipeline actually overlapped
+        # (PR 18), not an inference from wall clock
+        self._h2d: "dict[str, list]" = {}
+        self._overlap: "dict[str, int]" = {}
         # device-program launches by jitted entry point: the fused1
         # acceptance gate (legacy PUT seam = 3 passes/batch, fused1 = 1)
         self._passes: "dict[str, int]" = {}
@@ -108,6 +115,20 @@ class KernelStats:
             row = self._d2h.setdefault(plane, [0, 0])
             row[0] += 1
             row[1] += nbytes
+
+    def record_h2d(self, plane: str, nbytes: int) -> None:
+        """One host->device codec staging transfer (plane = data|parity)."""
+        with self._mu:
+            row = self._h2d.setdefault(plane, [0, 0])
+            row[0] += 1
+            row[1] += nbytes
+
+    def record_overlap_windows(self, plane: str, windows: int) -> None:
+        """``windows`` sub-chunks (or in-kernel tile steps) whose
+        transfer overlapped a neighbor's compute, keyed by direction:
+        plane = put (encode side) | get (verify/reconstruct side)."""
+        with self._mu:
+            self._overlap[plane] = self._overlap.get(plane, 0) + windows
 
     def record_pass(self, kernel: str) -> None:
         """One device-program launch (jitted codec pass) by entry-point
@@ -200,6 +221,14 @@ class KernelStats:
                     {"plane": plane, "transfers": n, "bytes": nbytes}
                     for plane, (n, nbytes) in sorted(self._d2h.items())
                 ],
+                "h2d": [
+                    {"plane": plane, "transfers": n, "bytes": nbytes}
+                    for plane, (n, nbytes) in sorted(self._h2d.items())
+                ],
+                "overlap_windows": {
+                    plane: self._overlap.get(plane, 0)
+                    for plane in ("put", "get")
+                },
                 "device_passes": dict(sorted(self._passes.items())),
                 "parity_cache": _parity_cache_stats(),
                 "hedge": {
@@ -262,6 +291,8 @@ class KernelStats:
             self._iopool_slowest_s = 0.0
             self._hedge.clear()
             self._d2h.clear()
+            self._h2d.clear()
+            self._overlap.clear()
             self._passes.clear()
             self._placement.clear()
             self._submesh_depth.clear()

@@ -186,6 +186,33 @@ def codec_formulation() -> str:
     return v if v in ("swar", "mxu") else "swar"
 
 
+def codec_overlap_mode() -> str:
+    """MINIO_TPU_CODEC_OVERLAP: ``pipeline`` | ``async`` | ``off``.
+
+    The device-side transfer/compute overlap seam (ROADMAP item 1):
+
+    * ``pipeline`` — the Pallas DMA pipeline: the fused1 kernels run
+      with an in-kernel w loop and manual double-buffered async copies
+      (rs_pallas.encode_pack_pipelined / verify_reconstruct_pipelined),
+      still ONE pallas_call per direction.  Needs the Pallas path
+      (TPU, or MINIO_TPU_CODEC_INTERPRET=1).
+    * ``async`` — the portable sub-chunk twin: the stripe batch splits
+      along w into S sub-chunks double-buffered through donated
+      ping-pong device buffers (encode_subchunk_words), so sub-chunk
+      N+1's H2D overlaps N's pass which overlaps N-1's drain on any
+      backend.  Honest about launches: S passes per direction.
+    * ``off`` — the serialized PR 14 path, the bisection oracle.
+
+    Default: ``pipeline`` on TPU, ``off`` elsewhere (on a host backend
+    the serialized path is already compute-bound; the overlap win is
+    the TPU bus/VPU story and CI exercises both modes explicitly).
+    """
+    v = os.environ.get("MINIO_TPU_CODEC_OVERLAP", "").strip().lower()
+    if v in ("pipeline", "async", "off"):
+        return v
+    return "pipeline" if jax.default_backend() == "tpu" else "off"
+
+
 def pallas_dispatch(words_per_shard: int) -> tuple[bool, bool]:
     """(use_pallas, interpret) statics for the fused1 entry points.
 
@@ -212,6 +239,7 @@ def pallas_dispatch(words_per_shard: int) -> tuple[bool, bool]:
         "formulation",
         "use_pallas",
         "interpret",
+        "pipeline",
     ),
     donate_argnums=(0,),
 )
@@ -223,6 +251,7 @@ def encode_words_fused1(
     formulation: str = "swar",
     use_pallas: bool = False,
     interpret: bool = False,
+    pipeline: bool = False,
 ):
     """fused1 PUT codec step: parity + digests + occupancy + pack in ONE
     device pass.
@@ -251,7 +280,14 @@ def encode_words_fused1(
         raise ValueError("words per shard must be a multiple of group")
 
     if use_pallas and m > 0 and w % rs_pallas._TW == 0:
-        parity, partials, flags_u, packed = rs_pallas.encode_pack_fused(
+        # pipeline=True swaps in the manual-DMA variant (same outputs,
+        # same single pallas_call): MINIO_TPU_CODEC_OVERLAP=pipeline
+        enc = (
+            rs_pallas.encode_pack_pipelined
+            if pipeline
+            else rs_pallas.encode_pack_fused
+        )
+        parity, partials, flags_u, packed = enc(
             words,
             m,
             group=group,
@@ -298,6 +334,7 @@ def encode_words_fused1(
         "formulation",
         "use_pallas",
         "interpret",
+        "pipeline",
     ),
 )
 def verify_and_reconstruct_words(
@@ -310,6 +347,7 @@ def verify_and_reconstruct_words(
     formulation: str = "swar",
     use_pallas: bool = False,
     interpret: bool = False,
+    pipeline: bool = False,
 ):
     """fused1 GET codec step: digest-verify + reconstruct in ONE pass.
 
@@ -334,7 +372,12 @@ def verify_and_reconstruct_words(
         raise ValueError(f"need {k} shards, have {len(idx)}")
     pres = jnp.asarray(np.asarray(present, dtype=bool))
     if use_pallas and w % rs_pallas._TW == 0:
-        data, partials = rs_pallas.verify_reconstruct_fused(
+        vr = (
+            rs_pallas.verify_reconstruct_pipelined
+            if pipeline
+            else rs_pallas.verify_reconstruct_fused
+        )
+        data, partials = vr(
             shards,
             tuple(idx),
             k,
@@ -353,6 +396,132 @@ def verify_and_reconstruct_words(
         )
     ok = jnp.all(got == digests, axis=-1) & pres
     return data, ok
+
+
+# ---------------------------------------------------------------------------
+# Sub-chunked async twin (MINIO_TPU_CODEC_OVERLAP=async): the portable
+# double-buffered pipeline for non-TPU backends and interpret/CI mode
+# ---------------------------------------------------------------------------
+#
+# The stripe batch splits along w into S sub-chunks; the backend stages
+# chunk s+1 H2D (jax.device_put is async) while chunk s's pass runs and
+# chunk s-1's results drain.  RS parity is column-local, so per-chunk
+# parity is exact; the phash256 partials XOR-accumulate across chunks
+# through a DONATED (B, n, 8) ping-pong accumulator whose key uses the
+# GLOBAL word offset (hash.tile_partials_batched), and the LAST chunk
+# finalizes in the same program — zero extra launches for the digest.
+# ``word_offset`` is traced, so every equal-sized chunk of a stream
+# shares one compiled program.
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("parity_shards", "shard_len", "group", "finalize"),
+    donate_argnums=(0, 1),
+)
+def encode_subchunk_words(
+    chunk: jax.Array,
+    acc: jax.Array,
+    word_offset,
+    parity_shards: int,
+    shard_len: int,
+    group: int = 0,
+    finalize: bool = False,
+):
+    """One PUT sub-chunk: parity + hash partials (+ flags/pack) for a
+    (B, k, cw) u32 slice of the stripe batch at global ``word_offset``.
+
+    ``chunk`` and ``acc`` are DONATED — the staging buffer dies into
+    the parity allocation and the partial accumulator ping-pongs
+    through the chunk chain.  Returns (parity (B, m, cw), acc' (B, n,
+    8) — FINALIZED digests when ``finalize``, raw partials otherwise,
+    flags (B, m, gc) bool, packed (B, m, cw)); group == 0 disables the
+    pack leg exactly like encode_words_fused1.  ``shard_len`` is the
+    FULL row byte length (the digest length-fold), not the chunk's.
+    """
+    B, k, cw = chunk.shape
+    m = parity_shards
+    if cw % 8:
+        raise ValueError("chunk words must be a multiple of 8")
+    if group and cw % group:
+        raise ValueError("chunk words must be a multiple of group")
+    if m > 0:
+        matrix = gf.parity_matrix(k, m)
+        flat = chunk.transpose(1, 0, 2).reshape(k, B * cw)
+        parity = rs._matmul_static(flat, matrix).reshape(m, B, cw)
+        aw = jnp.concatenate([chunk.transpose(1, 0, 2), parity], axis=0)
+        parity = parity.transpose(1, 0, 2)
+    else:
+        parity = jnp.zeros((B, 0, cw), jnp.uint32)
+        aw = chunk.transpose(1, 0, 2)
+    acc = acc ^ phash.tile_partials_batched(aw, word_offset).transpose(
+        1, 0, 2
+    )
+    out_acc = phash.finalize_partials(acc, shard_len) if finalize else acc
+    if not group:
+        return parity, out_acc, jnp.zeros((B, m, 0), bool), parity
+    gc = cw // group
+    grouped = parity.reshape(B, m, gc, group)
+    flags = (grouped != 0).any(axis=-1)
+    idx = jnp.arange(gc, dtype=jnp.int32)
+    key = jnp.where(flags, 0, jnp.int32(gc)) + idx
+    order = jnp.argsort(key, axis=-1)
+    packed = jnp.take_along_axis(
+        grouped, order[..., None], axis=-2
+    ).reshape(B, m, cw)
+    return parity, out_acc, flags, packed
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "present",
+        "data_shards",
+        "parity_shards",
+        "shard_len",
+        "finalize",
+    ),
+    donate_argnums=(0, 1),
+)
+def verify_reconstruct_subchunk_words(
+    chunk: jax.Array,
+    acc: jax.Array,
+    digests: jax.Array,
+    word_offset,
+    present: tuple[bool, ...],
+    data_shards: int,
+    parity_shards: int,
+    shard_len: int,
+    finalize: bool = False,
+):
+    """One GET sub-chunk: reconstruct a (B, n, cw) slice of the shard
+    rows AND accumulate verify partials (donated ping-pong ``acc`` and
+    staging ``chunk``, like encode_subchunk_words).
+
+    Returns (data (B, k, cw) u32, acc' (B, n, 8), ok (B, n) bool).
+    ``ok`` is meaningful only on the ``finalize`` call (digest match of
+    the WHOLE row AND present); earlier chunks return all-False — the
+    backend drains each data chunk D2H while the next one computes and
+    reads ``ok`` once from the last.
+    """
+    B, n, cw = chunk.shape
+    k, m = data_shards, parity_shards
+    idx = [i for i, p in enumerate(present) if p][:k]
+    if len(idx) < k:
+        raise ValueError(f"need {k} shards, have {len(idx)}")
+    acc = acc ^ phash.tile_partials_batched(
+        chunk.transpose(1, 0, 2), word_offset
+    ).transpose(1, 0, 2)
+    rm = gf.reconstruction_matrix(k, m, tuple(idx))
+    flat = chunk.transpose(1, 0, 2).reshape(n, B * cw)
+    surv = jnp.stack([flat[i] for i in idx])
+    data = rs._matmul_static(surv, rm).reshape(k, B, cw).transpose(1, 0, 2)
+    if finalize:
+        pres = jnp.asarray(np.asarray(present, dtype=bool))
+        got = phash.finalize_partials(acc, shard_len)
+        ok = jnp.all(got == digests, axis=-1) & pres
+        return data, acc, ok
+    return data, acc, jnp.zeros((B, n), bool)
 
 
 @functools.partial(jax.jit, static_argnames=("shard_len",))

@@ -211,6 +211,38 @@ def tile_partials(words, key):
     return jnp.concatenate([red(m1), red(m2)])
 
 
+def tile_partials_batched(words, offset):
+    """XOR partials of one contiguous sub-chunk over the LAST axis.
+
+    words: (..., w) uint32 with w a multiple of _PARTS; offset: scalar
+    uint32 global word index of the chunk start, TRACED so every
+    sub-chunk of a stream reuses one compiled program.  offset must be
+    a multiple of _PARTS (the strided word-index-mod-4 partitions must
+    stay aligned across chunks); the codec sub-chunk sizing guarantees
+    this by cutting on parity-group boundaries.  Returns (..., 8)
+    partials — XOR-fold the chunks in any order, then apply
+    finalize_partials to obtain phash256_words_batched output.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = words.shape[-1]
+    if n % _PARTS:
+        raise ValueError(f"word count {n} must be a multiple of {_PARTS}")
+    lead = words.shape[:-1]
+    idx = jnp.uint32(offset) + jax.lax.iota(jnp.uint32, n)
+    key = _mix_jnp(idx * _C1 + jnp.uint32(1))
+    m1 = _mix_jnp((words ^ key) * _M1)
+    m2 = _mix_jnp((words + key) * _M2)
+    red = lambda m: jax.lax.reduce(
+        m.reshape(*lead, n // _PARTS, _PARTS),
+        np.uint32(0),
+        jax.lax.bitwise_xor,
+        (len(lead),),
+    )
+    return jnp.concatenate([red(m1), red(m2)], axis=-1)
+
+
 def finalize_partials(partials, nbytes: int):
     """Length-fold of XOR-combined tile partials: (..., 8) -> (..., 8)."""
     import jax

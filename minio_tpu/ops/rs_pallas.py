@@ -450,12 +450,19 @@ def _fused1_kernel_factory(
     return kernel
 
 
-def _mxu_operand(matrix: np.ndarray):
-    """(bit-matrix input list, matching in_spec list) for an MXU kernel."""
+def _mxu_operand(matrix: np.ndarray, grid_dims: int = 2):
+    """(bit-matrix input list, matching in_spec list) for an MXU kernel.
+
+    ``grid_dims`` picks the index-map arity: 2 for the (batch, w-tile)
+    fused grids, 1 for the pipelined (batch,) grids whose w loop runs
+    inside the kernel."""
     o, s = matrix.shape
     key = np.ascontiguousarray(matrix, dtype=np.uint8).tobytes()
     mat = jnp.asarray(_bit_matrix(key, o, s))
-    return [mat], [pl.BlockSpec((8 * o, 8 * s), lambda b, i: (0, 0))]
+    index_map = (
+        (lambda b: (0, 0)) if grid_dims == 1 else (lambda b, i: (0, 0))
+    )
+    return [mat], [pl.BlockSpec((8 * o, 8 * s), index_map)]
 
 
 @functools.partial(
@@ -627,6 +634,376 @@ def verify_reconstruct_fused(
         out_specs=(
             pl.BlockSpec((1, k, _TW), lambda b, i: (b, 0, i)),
             pl.BlockSpec((1, n, 8), lambda b, i: (b, 0, 0)),
+        ),
+        interpret=interpret,
+    )(*extra_in, shards)
+    return data, hacc
+
+
+# ---------------------------------------------------------------------------
+# DMA-pipelined codec (MINIO_TPU_CODEC_OVERLAP=pipeline): manual
+# double-buffered HBM<->VMEM staging inside ONE pallas_call per direction
+# ---------------------------------------------------------------------------
+#
+# The fused1 kernels above lean on the blocked-grid pipeline Pallas
+# derives from their BlockSpecs; these variants restructure the same
+# math around explicit make_async_copy stages so the overlap is under
+# our control and visible: the shard plane stays in ANY/HBM memory
+# space, a 2-slot VMEM double buffer prefetches w-tile t+1 while tile t
+# computes, and the parity (or reconstructed-data) tile of t-1 drains
+# VMEM->HBM behind the compute - the three-deep sub-chunk pipeline of
+# ROADMAP item 1, one level below the host's batch double buffering.
+# Outputs are bit-identical to the fused kernels: the hash accumulator,
+# occupancy flags and the packed row stay VMEM-resident across the
+# in-kernel w loop exactly as the fused kernels carry them across grid
+# steps.
+
+
+def _pipe_encode_kernel_factory(
+    matrix: np.ndarray, tw: int, group: int, formulation: str, nt: int
+):
+    m, k = matrix.shape
+    mxu = _rows_fn(formulation) is _mxu_rows
+    gpt = tw // group if group else 0
+
+    def impl(data_hbm, parity_hbm, hacc_ref, flags_ref, packed_ref, mat):
+        # hoisted: program_id inside lax.cond/fori closures does not
+        # lower under interpret mode
+        b = pl.program_id(0)
+        hacc_ref[...] = jnp.zeros_like(hacc_ref)
+        if group:
+            packed_ref[...] = jnp.zeros_like(packed_ref)
+
+        def scoped(in_vmem, par_vmem, in_sem, par_sem, kept_ref):
+            def in_copy(t, slot):
+                return pltpu.make_async_copy(
+                    data_hbm.at[b, :, pl.ds(t * tw, tw)],
+                    in_vmem.at[slot],
+                    in_sem.at[slot],
+                )
+
+            def par_copy(t, slot):
+                return pltpu.make_async_copy(
+                    par_vmem.at[slot],
+                    parity_hbm.at[b, :, pl.ds(t * tw, tw)],
+                    par_sem.at[slot],
+                )
+
+            if group:
+                for r in range(m):
+                    kept_ref[r] = 0
+            in_copy(0, 0).start()  # warm-up: stage tile 0
+
+            def body(t, carry):
+                slot = jax.lax.rem(t, 2)
+                nslot = jax.lax.rem(t + 1, 2)
+
+                @pl.when(t + 1 < nt)
+                def _prefetch():
+                    in_copy(t + 1, nslot).start()
+
+                in_copy(t, slot).wait()
+                data = in_vmem[slot]
+                parity_rows = (
+                    _mxu_rows(matrix, data, mat)
+                    if mxu
+                    else _swar_rows(matrix, data)
+                )
+                all_rows = jnp.concatenate(
+                    [data, jnp.stack(parity_rows)], axis=0
+                )
+                par_vmem[slot] = all_rows[k:]
+                hacc_ref[0] = hacc_ref[0] ^ _tile_hash_partials(
+                    all_rows, t, tw
+                )
+                par_copy(t, slot).start()
+                if group:
+                    flags = [
+                        [
+                            jnp.any(
+                                parity_rows[r][
+                                    j * group : (j + 1) * group
+                                ]
+                                != 0
+                            )
+                            for j in range(gpt)
+                        ]
+                        for r in range(m)
+                    ]
+                    flags_ref[0, :, pl.ds(t * gpt, gpt)] = jnp.stack(
+                        [
+                            jnp.stack(fr).astype(jnp.uint32)
+                            for fr in flags
+                        ]
+                    )
+                    for r in range(m):
+                        off = kept_ref[r]
+                        for j in range(gpt):
+
+                            @pl.when(flags[r][j])
+                            def _store(off=off, r=r, j=j):
+                                packed_ref[
+                                    0, r, pl.ds(off * group, group)
+                                ] = parity_rows[r][
+                                    j * group : (j + 1) * group
+                                ]
+
+                            off = off + flags[r][j].astype(jnp.int32)
+                        kept_ref[r] = off
+
+                @pl.when(t >= 1)
+                def _drain_prev():
+                    par_copy(t - 1, nslot).wait()
+
+                return carry
+
+            jax.lax.fori_loop(0, nt, body, 0)
+            par_copy(nt - 1, (nt - 1) % 2).wait()
+
+        pl.run_scoped(
+            scoped,
+            in_vmem=pltpu.VMEM((2, k, tw), jnp.uint32),
+            par_vmem=pltpu.VMEM((2, m, tw), jnp.uint32),
+            in_sem=pltpu.SemaphoreType.DMA((2,)),
+            par_sem=pltpu.SemaphoreType.DMA((2,)),
+            kept_ref=pltpu.SMEM((max(m, 1),), jnp.int32),
+        )
+
+    if mxu and group:
+
+        def kernel(mat_ref, data_hbm, parity_hbm, hacc_ref, flags_ref,
+                   packed_ref):
+            impl(data_hbm, parity_hbm, hacc_ref, flags_ref, packed_ref,
+                 mat_ref[...])
+
+    elif mxu:
+
+        def kernel(mat_ref, data_hbm, parity_hbm, hacc_ref):
+            impl(data_hbm, parity_hbm, hacc_ref, None, None, mat_ref[...])
+
+    elif group:
+
+        def kernel(data_hbm, parity_hbm, hacc_ref, flags_ref, packed_ref):
+            impl(data_hbm, parity_hbm, hacc_ref, flags_ref, packed_ref,
+                 None)
+
+    else:
+
+        def kernel(data_hbm, parity_hbm, hacc_ref):
+            impl(data_hbm, parity_hbm, hacc_ref, None, None, None)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("parity_shards", "group", "formulation", "interpret"),
+)
+def encode_pack_pipelined(
+    words,
+    parity_shards: int,
+    group: int = 0,
+    formulation: str = "swar",
+    interpret: bool = False,
+):
+    """DMA-pipelined twin of encode_pack_fused: same outputs, same ONE
+    pallas_call, but the w loop runs inside the kernel with manual
+    double-buffered async copies so tile t+1's HBM->VMEM staging and
+    tile t-1's parity VMEM->HBM drain overlap tile t's compute.
+
+    Bit-identity contract (non-negotiable, tests/test_overlap.py):
+    parity, un-finalized hash partials and flags are element-identical
+    to encode_pack_fused; ``packed`` agrees on the compacted prefix
+    [0, kept_r*group) of every row — all the drain ever reads
+    (compress.unpack_nonzero_groups) — with zeros behind it.
+    """
+    B, k, w = words.shape
+    m = parity_shards
+    n = k + m
+    if m <= 0:
+        raise ValueError("encode_pack_pipelined needs parity_shards >= 1")
+    if w % _TW:
+        raise ValueError(f"words per shard ({w}) must be a multiple of {_TW}")
+    if group and _TW % group:
+        raise ValueError(f"group must divide the {_TW}-word tile")
+    nt = w // _TW
+    matrix = gf.parity_matrix(k, m)
+    kernel = _pipe_encode_kernel_factory(
+        matrix, _TW, group, formulation, nt
+    )
+    extra_in, extra_specs = (
+        _mxu_operand(matrix, grid_dims=1)
+        if formulation == "mxu"
+        else ([], [])
+    )
+    in_specs = extra_specs + [pl.BlockSpec(memory_space=pltpu.ANY)]
+    if not group:
+        parity, hacc = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((B, m, w), jnp.uint32),
+                jax.ShapeDtypeStruct((B, n, 8), jnp.uint32),
+            ),
+            grid=(B,),
+            in_specs=in_specs,
+            out_specs=(
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec((1, n, 8), lambda b: (b, 0, 0)),
+            ),
+            interpret=interpret,
+        )(*extra_in, words)
+        return parity, hacc, jnp.zeros((B, m, 0), jnp.uint32), parity
+    g = w // group
+    parity, hacc, flags, packed = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, m, w), jnp.uint32),
+            jax.ShapeDtypeStruct((B, n, 8), jnp.uint32),
+            jax.ShapeDtypeStruct((B, m, g), jnp.uint32),
+            jax.ShapeDtypeStruct((B, m, w), jnp.uint32),
+        ),
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, n, 8), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, m, g), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, m, w), lambda b: (b, 0, 0)),
+        ),
+        interpret=interpret,
+    )(*extra_in, words)
+    return parity, hacc, flags, packed
+
+
+def _pipe_vr_kernel_factory(
+    rmatrix: np.ndarray,
+    idx: tuple,
+    n: int,
+    tw: int,
+    formulation: str,
+    nt: int,
+):
+    mxu = _rows_fn(formulation) is _mxu_rows
+    k = rmatrix.shape[0]
+
+    def impl(sh_hbm, data_hbm, hacc_ref, mat):
+        b = pl.program_id(0)  # hoisted (see _pipe_encode_kernel_factory)
+        hacc_ref[...] = jnp.zeros_like(hacc_ref)
+
+        def scoped(in_vmem, out_vmem, in_sem, out_sem):
+            def in_copy(t, slot):
+                return pltpu.make_async_copy(
+                    sh_hbm.at[b, :, pl.ds(t * tw, tw)],
+                    in_vmem.at[slot],
+                    in_sem.at[slot],
+                )
+
+            def out_copy(t, slot):
+                return pltpu.make_async_copy(
+                    out_vmem.at[slot],
+                    data_hbm.at[b, :, pl.ds(t * tw, tw)],
+                    out_sem.at[slot],
+                )
+
+            in_copy(0, 0).start()
+
+            def body(t, carry):
+                slot = jax.lax.rem(t, 2)
+                nslot = jax.lax.rem(t + 1, 2)
+
+                @pl.when(t + 1 < nt)
+                def _prefetch():
+                    in_copy(t + 1, nslot).start()
+
+                in_copy(t, slot).wait()
+                sh = in_vmem[slot]  # (n, tw), rows AS READ
+                surv = jnp.stack([sh[j, :] for j in idx])
+                rows = (
+                    _mxu_rows(rmatrix, surv, mat)
+                    if mxu
+                    else _swar_rows(rmatrix, surv)
+                )
+                out_vmem[slot] = jnp.stack(rows)
+                hacc_ref[0] = hacc_ref[0] ^ _tile_hash_partials(sh, t, tw)
+                out_copy(t, slot).start()
+
+                @pl.when(t >= 1)
+                def _drain_prev():
+                    out_copy(t - 1, nslot).wait()
+
+                return carry
+
+            jax.lax.fori_loop(0, nt, body, 0)
+            out_copy(nt - 1, (nt - 1) % 2).wait()
+
+        pl.run_scoped(
+            scoped,
+            in_vmem=pltpu.VMEM((2, n, tw), jnp.uint32),
+            out_vmem=pltpu.VMEM((2, k, tw), jnp.uint32),
+            in_sem=pltpu.SemaphoreType.DMA((2,)),
+            out_sem=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    if mxu:
+
+        def kernel(mat_ref, sh_hbm, data_hbm, hacc_ref):
+            impl(sh_hbm, data_hbm, hacc_ref, mat_ref[...])
+
+    else:
+
+        def kernel(sh_hbm, data_hbm, hacc_ref):
+            impl(sh_hbm, data_hbm, hacc_ref, None)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "present_idx",
+        "data_shards",
+        "parity_shards",
+        "formulation",
+        "interpret",
+    ),
+)
+def verify_reconstruct_pipelined(
+    shards,
+    present_idx: tuple,
+    data_shards: int,
+    parity_shards: int,
+    formulation: str = "swar",
+    interpret: bool = False,
+):
+    """DMA-pipelined twin of verify_reconstruct_fused (same outputs,
+    one pallas_call): shard-tile staging, the verify+reconstruct
+    compute, and the reconstructed-data drain overlap per w-tile."""
+    B, n, w = shards.shape
+    k, m = data_shards, parity_shards
+    if n != k + m:
+        raise ValueError("shard rows must equal k + m")
+    idx = tuple(int(i) for i in present_idx)
+    if len(idx) != k:
+        raise ValueError(f"need exactly {k} survivor indices, got {len(idx)}")
+    if w % _TW:
+        raise ValueError(f"words per shard ({w}) must be a multiple of {_TW}")
+    nt = w // _TW
+    rm = gf.reconstruction_matrix(k, m, idx)
+    kernel = _pipe_vr_kernel_factory(rm, idx, n, _TW, formulation, nt)
+    extra_in, extra_specs = (
+        _mxu_operand(rm, grid_dims=1) if formulation == "mxu" else ([], [])
+    )
+    data, hacc = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, k, w), jnp.uint32),
+            jax.ShapeDtypeStruct((B, n, 8), jnp.uint32),
+        ),
+        grid=(B,),
+        in_specs=extra_specs + [pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, n, 8), lambda b: (b, 0, 0)),
         ),
         interpret=interpret,
     )(*extra_in, shards)

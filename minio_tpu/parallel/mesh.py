@@ -65,6 +65,35 @@ def make_mesh(
     return Mesh(arr, ("stripe", "shard"))
 
 
+_overlap_fallback_warned = False
+
+
+def warn_overlap_fallback() -> None:
+    """Warn once that MINIO_TPU_CODEC_OVERLAP degrades to "off" on mesh.
+
+    The sub-chunk overlap pipeline double-buffers per-device staging
+    arrays; the mesh entry points shard one whole stripe batch across
+    devices with collective parity accumulation, so splitting the
+    stripe-length axis again underneath them would fight the "seq"
+    axis for the same dimension.  Mesh callers silently get the
+    serialized (bit-identical) path; this warning surfaces that the
+    overlap knob is being ignored so operators do not chase missing
+    overlap_windows counters on multi-device runs.
+    """
+    global _overlap_fallback_warned
+    if _overlap_fallback_warned:
+        return
+    _overlap_fallback_warned = True
+    import warnings
+
+    warnings.warn(
+        "MINIO_TPU_CODEC_OVERLAP is not supported on the device-mesh "
+        "codec path; falling back to the serialized (off) pipeline",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def xor_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
     """All-reduce with XOR over a mesh axis via recursive doubling.
 

@@ -513,6 +513,19 @@ class AdminAPI:
         doc["select"] = dict(
             seldev.STATS.snapshot(), mode=seldev.select_mode()
         )
+        # device transfer/compute overlap: configured mode plus the
+        # windows the codec actually opened and the per-plane bus
+        # traffic backing them (codec/telemetry.py)
+        from ..codec.telemetry import KERNEL_STATS
+        from ..ops import codec_step
+
+        ksnap = KERNEL_STATS.snapshot()
+        doc["codec_overlap"] = {
+            "mode": codec_step.codec_overlap_mode(),
+            "overlap_windows": ksnap["overlap_windows"],
+            "h2d": ksnap["h2d"],
+            "d2h": ksnap["d2h"],
+        }
         try:
             page = _os.sysconf("SC_PAGE_SIZE")
             doc["mem_total_bytes"] = page * _os.sysconf("SC_PHYS_PAGES")

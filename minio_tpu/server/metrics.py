@@ -498,6 +498,26 @@ class Metrics:
             "Device->host codec readback transfers by plane",
             [({"plane": r["plane"]}, r["transfers"]) for r in d2h],
         )
+        h2d = {r["plane"]: r for r in snap.get("h2d", [])}
+        emit(
+            "miniotpu_codec_h2d_bytes_total", "counter",
+            "Host->device codec staging bytes by plane (data|parity)",
+            [({"plane": p}, h2d.get(p, {}).get("bytes", 0))
+             for p in ("data", "parity")],
+        )
+        emit(
+            "miniotpu_codec_h2d_transfers_total", "counter",
+            "Host->device codec staging transfers by plane",
+            [({"plane": p}, h2d.get(p, {}).get("transfers", 0))
+             for p in ("data", "parity")],
+        )
+        ow = snap.get("overlap_windows", {})
+        emit(
+            "miniotpu_codec_overlap_windows_total", "counter",
+            "Transfer/compute overlap windows opened by direction "
+            "(put = encode side, get = verify/reconstruct side)",
+            [({"direction": d}, ow.get(d, 0)) for d in ("put", "get")],
+        )
         pc = snap.get("parity_cache", {})
         emit(
             "miniotpu_codec_parity_cache_bytes", "gauge",

@@ -1,0 +1,13 @@
+"""MTPU505 fixture: sub-chunked-seam drift — a chunked pipeline entry
+point declaring multi-argument donation (the staging chunk AND the
+ping-pong accumulator, the PR 18 async-overlap shape) that the
+kernel_contracts DONATING_ENTRY_POINTS table does not know about."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def encode_chunk_probe(chunk, acc, word_offset):  # VIOLATION: MTPU505
+    return chunk, acc ^ acc

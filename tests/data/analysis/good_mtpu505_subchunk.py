@@ -1,0 +1,12 @@
+"""MTPU505 twin: the same sub-chunked entry point with the donation
+expressed only through statics — no donate_argnums literal, so there is
+no registry fact to drift from."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("finalize",))
+def encode_chunk_probe(chunk, acc, word_offset, finalize=False):
+    return chunk, acc ^ acc

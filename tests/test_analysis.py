@@ -814,7 +814,9 @@ def _deviceflow_fixture(name, *, rel_path=None):
 
 
 @pytest.mark.parametrize(
-    "name", [f"bad_mtpu50{i}.py" for i in range(1, 6)]
+    "name",
+    [f"bad_mtpu50{i}.py" for i in range(1, 6)]
+    + ["bad_mtpu505_subchunk.py"],
 )
 def test_bad_deviceflow_fixture_exact_findings(name):
     expected = _expected_markers(name)
@@ -824,7 +826,9 @@ def test_bad_deviceflow_fixture_exact_findings(name):
 
 
 @pytest.mark.parametrize(
-    "name", [f"good_mtpu50{i}.py" for i in range(1, 6)]
+    "name",
+    [f"good_mtpu50{i}.py" for i in range(1, 6)]
+    + ["good_mtpu505_subchunk.py"],
 )
 def test_good_deviceflow_fixture_clean(name):
     found = _deviceflow_fixture(name)

@@ -285,6 +285,34 @@ def test_device_tier_respects_shared_budget():
     assert budget.usage("read_cache") == per_entry
 
 
+def test_device_tier_yields_to_codec_staging():
+    """The async overlap pipeline's ping-pong staging (PR 18) posts to
+    the same device-byte ledger as the parity plane: while a
+    sub-chunked encode is in flight, device cache admissions overflow
+    to the host tier — the cache yields; staging bytes are never an
+    eviction victim."""
+    data, digests = _group()
+    per_entry = data.nbytes + digests.nbytes
+    budget = DeviceBudget(per_entry * 2)
+    budget.set_usage("codec_staging", per_entry * 2)  # encode in flight
+    c = TieredReadCache(
+        TIER_DEVICE,
+        host_capacity=1 << 20,
+        device_capacity=1 << 20,
+        budget=budget,
+    )
+    assert c.put(_key("o"), "bucket/o", data, digests)
+    st = c.stats()["tiers"]
+    assert st[TIER_DEVICE]["entries"] == 0
+    assert st[TIER_HOST]["entries"] == 1
+    # the contest left the staging reservation untouched
+    assert budget.usage("codec_staging") == per_entry * 2
+    # encode_digest_end released the ping-pong: device tier reopens
+    budget.set_usage("codec_staging", 0)
+    assert c.put(_key("o2"), "bucket/o2", data, digests)
+    assert c.stats()["tiers"][TIER_DEVICE]["entries"] == 1
+
+
 def test_device_eviction_demotes_to_host():
     data, digests = _group()
     per_entry = data.nbytes + digests.nbytes
