@@ -1613,7 +1613,9 @@ def bench_concurrency_sweep(
     try:
         for mode in ("threaded", "async"):
             root = tempfile.mkdtemp(prefix=f"minio-tpu-csweep-{mode}-")
-            srv, saved = _boot(mode, root)
+            # single loop pinned: these rows are the threaded-vs-async
+            # oracle comparison; the loops axis lives in the storm tier
+            srv, saved = _boot(mode, root, MINIO_TPU_SERVER_LOOPS=1)
             try:
                 boot = _Client(srv.endpoint)
                 assert boot.request("PUT", "/bench") == 200
@@ -1656,6 +1658,7 @@ def bench_concurrency_sweep(
         root = tempfile.mkdtemp(prefix="minio-tpu-csweep-shed-")
         srv, saved = _boot(
             "async", root,
+            MINIO_TPU_SERVER_LOOPS=1,  # exact single-queue semantics
             MINIO_TPU_SERVER_WORKERS=2, MINIO_TPU_SERVER_BACKLOG=2,
         )
         try:
@@ -1698,6 +1701,469 @@ def bench_concurrency_sweep(
                 t[f"{op}_p99_ms"] / a[f"{op}_p99_ms"], 2
             )
     results["acceptance"] = ratios
+    results["storm"] = bench_connection_storm()
+    return results
+
+
+def bench_connection_storm(
+    duration_s: float = 6.0,
+    active_clients: int = 256,
+    loris_conns: int = 256,
+    pipeline_depth: int = 64,
+) -> dict:
+    """Connection-storm tier of --concurrency: the multi-loop front
+    plane under 10k-class keep-alive connection counts, driven by a
+    lightweight in-process asyncio client (one OS thread holds every
+    client connection, so the storm measures the SERVER, not a client
+    thread pool).
+
+    Cells, per loop count (async@1 oracle vs async@N):
+
+    - correctness gate BEFORE any timing: pathological pipelining
+      (``pipeline_depth`` GETs burst-written in one segment, responses
+      must come back in order, bodies bit-exact) and a SHA-256 running
+      digest over every response body that must match across loop
+      counts (bit-identity between 1 and N loops is a hard gate);
+    - connection hold: open ~10k keep-alive connections in waves
+      (MINIO_TPU_BENCH_STORM_CONNS overrides; clamped to the fd
+      rlimit), each proves liveness with one small GET;
+    - timed GET storm over ``active_clients`` of the held
+      connections -> throughput + p99 while thousands of idle
+      connections stay parked;
+    - slow-loris flood: ``loris_conns`` connections trickle a request
+      head forever; a concurrent GET flood on healthy connections must
+      keep completing with correct bodies.
+
+    A separate overload cell pins MINIO_TPU_TENANT_MAX_INFLIGHT and
+    floods 64 one-shot clients: every response is 200 or an honest 503,
+    and the healthinfo admission block's tenant high-water mark must
+    show the GLOBAL cap was never exceeded across loops.
+    """
+    import asyncio
+    import datetime
+    import hashlib
+    import os
+    import resource
+    import shutil
+    import tempfile
+
+    from minio_tpu.codec import backend as backend_mod
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.server import auth
+    from minio_tpu.server.http import S3Server
+    from minio_tpu.storage.xl import XLStorage
+
+    cores = os.cpu_count() or 1
+    soft_nofile, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    want = int(os.environ.get("MINIO_TPU_BENCH_STORM_CONNS", "0")) or (
+        10_000 if cores >= 2 else 2_000
+    )
+    # every client connection costs two fds here (server is in-process)
+    n_conns = max(active_clients, min(want, (soft_nofile - 512) // 2))
+    multi_loops = min(max(cores, 2), 4)
+
+    obj = np.random.default_rng(19).integers(
+        0, 256, 8 << 10, dtype=np.uint8
+    ).tobytes()
+    slow_obj = np.random.default_rng(20).integers(
+        0, 256, 1 << 20, dtype=np.uint8
+    ).tobytes()
+    phash_empty = hashlib.sha256(b"").hexdigest()
+
+    def _head(host, port, path):
+        """One signed GET request head (SigV4, keep-alive), as bytes -
+        signed once and reused for every request on the storm's hot
+        path so the driver stays lighter than the server."""
+        amz = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y%m%dT%H%M%SZ"
+        )
+        headers = {
+            "host": f"{host}:{port}",
+            "x-amz-date": amz,
+            "x-amz-content-sha256": phash_empty,
+        }
+        signed = sorted(headers)
+        sig = auth.sign_v4(
+            "GET", path, {}, headers, signed, phash_empty,
+            "minioadmin", "minioadmin", amz, "us-east-1",
+        )
+        scope = f"{amz[:8]}/us-east-1/s3/aws4_request"
+        headers["authorization"] = (
+            f"{auth.SIGN_V4_ALGORITHM} "
+            f"Credential=minioadmin/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+        )
+        lines = [f"GET {path} HTTP/1.1"]
+        lines += [f"{k}: {v}" for k, v in headers.items()]
+        return ("\r\n".join(lines) + "\r\n\r\n").encode()
+
+    def _put_seed(host, port, path, body):
+        """One signed PUT over a throwaway connection (seeding)."""
+        import http.client as _hc
+
+        amz = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y%m%dT%H%M%SZ"
+        )
+        ph = hashlib.sha256(body).hexdigest()
+        hdrs = {
+            "host": f"{host}:{port}",
+            "x-amz-date": amz,
+            "x-amz-content-sha256": ph,
+        }
+        signed = sorted(hdrs)
+        sig = auth.sign_v4(
+            "PUT", path, {}, hdrs, signed, ph,
+            "minioadmin", "minioadmin", amz, "us-east-1",
+        )
+        scope = f"{amz[:8]}/us-east-1/s3/aws4_request"
+        hdrs["authorization"] = (
+            f"{auth.SIGN_V4_ALGORITHM} "
+            f"Credential=minioadmin/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}"
+        )
+        hc = _hc.HTTPConnection(host, port, timeout=60)
+        try:
+            hc.request("PUT", path, body=body or None, headers=hdrs)
+            resp = hc.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"storm seed PUT {path}: {resp.status}"
+                )
+        finally:
+            hc.close()
+
+    async def _read_resp(r):
+        """Minimal HTTP/1.1 response read: (status, body)."""
+        status_line = await r.readline()
+        if not status_line:
+            return None, b""
+        status = int(status_line.split()[1])
+        clen = 0
+        while True:
+            line = await r.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.partition(b":")
+            if k.strip().lower() == b"content-length":
+                clen = int(v)
+        body = await r.readexactly(clen) if clen else b""
+        return status, body
+
+    def _boot(loops, **env):
+        env = {
+            "MINIO_TPU_SERVER": "async",
+            "MINIO_TPU_SERVER_LOOPS": str(loops),
+            **{k: str(v) for k, v in env.items()},
+        }
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        root = tempfile.mkdtemp(prefix="minio-tpu-storm-")
+        disks = [XLStorage(f"{root}/d{i}") for i in range(8)]
+        ol = ErasureObjects(disks, parity_blocks=4, block_size=BLOCK)
+        srv = S3Server(ol, address="127.0.0.1:0").start()
+        host, port = srv.endpoint.split("//")[1].rsplit(":", 1)
+        return srv, saved, root, host, int(port)
+
+    def _restore(saved, root):
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(root, ignore_errors=True)
+
+    async def _storm_cell(host, port, head, digest):
+        """One loop count's full storm; returns the cell row.  Raises
+        RuntimeError on ANY correctness violation (hard gate)."""
+        cell = {}
+
+        # -- correctness gate: pathological pipelining, before timing
+        r, w = await asyncio.open_connection(host, port)
+        try:
+            for _round in range(2):
+                w.write(head * pipeline_depth)  # one burst segment
+                await w.drain()
+                for i in range(pipeline_depth):
+                    st, body = await _read_resp(r)
+                    if st != 200 or body != obj:
+                        raise RuntimeError(
+                            f"pipelining: resp {i} status={st} "
+                            f"len={len(body)}"
+                        )
+                    digest.update(body)
+        finally:
+            w.close()
+        cell["pipelining"] = {
+            "depth": pipeline_depth, "rounds": 2, "ordered": True
+        }
+
+        # -- connection hold: waves of keep-alive conns, one GET each.
+        # A 503 SlowDown is an HONEST answer under a connect flood
+        # (bounded handler queue) - the client retries on the same
+        # connection like a real SDK; anything else is a hard failure.
+        conns, connect_errors, hold_sheds = [], 0, [0]
+        sem = asyncio.Semaphore(64)  # connect-wave width
+
+        async def _checked_get(r, w):
+            """One GET on an open conn; retries honest sheds.
+            Returns the number of 503s absorbed."""
+            sheds = 0
+            while True:
+                w.write(head)
+                await w.drain()
+                st, body = await _read_resp(r)
+                if st == 200 and body == obj:
+                    return sheds
+                if st == 503:
+                    sheds += 1
+                    await asyncio.sleep(0.01 * min(sheds, 20))
+                    continue
+                raise RuntimeError(
+                    f"GET status={st} len={len(body)}"
+                )
+
+        async def _hold():
+            nonlocal connect_errors
+            async with sem:
+                try:
+                    r, w = await asyncio.open_connection(host, port)
+                    hold_sheds[0] += await _checked_get(r, w)
+                    conns.append((r, w))
+                except OSError:
+                    connect_errors += 1
+
+        await asyncio.gather(*[_hold() for _ in range(n_conns)])
+        if connect_errors:
+            raise RuntimeError(
+                f"{connect_errors}/{n_conns} storm connects failed"
+            )
+        cell["held_conns"] = len(conns)
+        cell["hold_sheds_retried"] = hold_sheds[0]
+
+        # -- timed GET storm on a slice of the held connections while
+        #    the rest stay parked (sheds counted, not timed)
+        lats, storm_sheds = [], [0]
+        stop_at = time.perf_counter() + duration_s
+
+        async def _active(pair):
+            r, w = pair
+            n = 0
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                storm_sheds[0] += await _checked_get(r, w)
+                lats.append(time.perf_counter() - t0)
+                n += 1
+            return n
+
+        done = await asyncio.gather(
+            *[_active(p) for p in conns[:active_clients]]
+        )
+        total = sum(done)
+        lats.sort()
+        cell["get"] = {
+            "active_clients": active_clients,
+            "idle_parked": len(conns) - active_clients,
+            "ops": total,
+            "sheds_retried": storm_sheds[0],
+            "rps": round(total / duration_s, 1),
+            "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+            "p99_ms": round(
+                lats[max(0, int(len(lats) * 0.99) - 1)] * 1e3, 2
+            ),
+        }
+
+        # -- slow-loris flood: trickling heads must not stall healthy
+        #    connections (server read timeout reaps them eventually)
+        loris = []
+        for _ in range(loris_conns):
+            r, w = await asyncio.open_connection(host, port)
+            w.write(b"GET /bench/storm HTTP/1.1\r\n")
+            await w.drain()
+            loris.append((r, w))
+
+        async def _trickle(pair):
+            _r, w = pair
+            try:
+                for ch in "x-trickle: slow\r\n":
+                    w.write(ch.encode())
+                    await w.drain()
+                    await asyncio.sleep(0.25)
+            except (ConnectionError, OSError):
+                pass  # server reaped the loris - that is a fine answer
+
+        trickles = [
+            asyncio.ensure_future(_trickle(p)) for p in loris
+        ]
+        flood_done = [0]
+        flood_stop = time.perf_counter() + 3.0
+
+        async def _flood(pair):
+            r, w = pair
+            while time.perf_counter() < flood_stop:
+                await _checked_get(r, w)
+                flood_done[0] += 1
+
+        await asyncio.gather(*[_flood(p) for p in conns[:64]])
+        for t in trickles:
+            t.cancel()
+        for _r, w in loris:
+            w.close()
+        if not flood_done[0]:
+            raise RuntimeError("no GET completed under slow-loris")
+        cell["loris"] = {
+            "conns": loris_conns,
+            "flood_clients": 64,
+            "flood_window_s": 3.0,
+            "flood_completed": flood_done[0],
+        }
+
+        for _r, w in conns:
+            w.close()
+        return cell
+
+    saved_backend = os.environ.get("MINIO_ERASURE_BACKEND")
+    os.environ["MINIO_ERASURE_BACKEND"] = "cpu"
+    backend_mod.reset_backend()
+    results = {
+        "conns": n_conns,
+        "cores": cores,
+        "cells": {},
+        "tenant_cap": None,
+    }
+    digests = {}
+    try:
+        for loops in (1, multi_loops):
+            srv, saved, root, host, port = _boot(
+                loops,
+                # a deep handler queue keeps honest sheds rare so the
+                # timed section measures service, not retry backoff
+                MINIO_TPU_SERVER_WORKERS=16,
+                MINIO_TPU_SERVER_BACKLOG=4096,
+            )
+            try:
+                # seed through the same wire the storm uses
+                _put_seed(host, port, "/bench", b"")
+                _put_seed(host, port, "/bench/storm", obj)
+                head = _head(host, port, "/bench/storm")
+                digest = hashlib.sha256()
+                cell = asyncio.run(
+                    _storm_cell(host, port, head, digest)
+                )
+                cell["loops"] = loops
+                digests[loops] = digest.hexdigest()
+                results["cells"][str(loops)] = cell
+            finally:
+                srv.shutdown(drain_s=5.0)
+                _restore(saved, root)
+
+        # hard gate: both loop counts returned bit-identical bodies
+        results["body_digest_by_loops"] = {
+            str(k): v for k, v in digests.items()
+        }
+        results["bit_identical"] = (
+            len(set(digests.values())) == 1
+        )
+        if not results["bit_identical"]:
+            raise RuntimeError(
+                f"loop counts disagree on response bytes: {digests}"
+            )
+
+        # -- overload cell: global tenant cap must hold EXACTLY across
+        #    loops, sheds must be honest 503s
+        cap = 8
+        srv, saved, root, host, port = _boot(
+            multi_loops,
+            MINIO_TPU_SERVER_WORKERS=24,
+            MINIO_TPU_SERVER_BACKLOG=64,
+            MINIO_TPU_TENANT_MAX_INFLIGHT=cap,
+        )
+        try:
+            _put_seed(host, port, "/bench", b"")
+            _put_seed(host, port, "/bench/slow", slow_obj)
+            slow_head = _head(host, port, "/bench/slow")
+            statuses = []
+
+            async def _one_shot():
+                try:
+                    r, w = await asyncio.open_connection(host, port)
+                except OSError:
+                    statuses.append(-1)
+                    return
+                try:
+                    w.write(slow_head)
+                    await w.drain()
+                    st, body = await _read_resp(r)
+                    if st == 200 and body != slow_obj:
+                        raise RuntimeError("cap GET body mismatch")
+                    statuses.append(st if st is not None else -1)
+                finally:
+                    w.close()
+
+            async def _cap_flood():
+                await asyncio.gather(
+                    *[_one_shot() for _ in range(64)]
+                )
+
+            asyncio.run(_cap_flood())
+            counts = {
+                str(s): statuses.count(s) for s in sorted(set(statuses))
+            }
+            dishonest = [
+                s for s in statuses if s not in (200, 503)
+            ]
+            if dishonest:
+                raise RuntimeError(
+                    f"non-200/503 answers under overload: {counts}"
+                )
+            hwm = srv.admission.budget.tenant_hwm().get("minioadmin", 0)
+            results["tenant_cap"] = {
+                "loops": multi_loops,
+                "cap": cap,
+                "clients": 64,
+                "statuses": counts,
+                "tenant_hwm": hwm,
+                "held": hwm <= cap,
+            }
+            if hwm > cap:
+                raise RuntimeError(
+                    f"GLOBAL tenant cap exceeded: hwm={hwm} cap={cap}"
+                )
+        finally:
+            srv.shutdown(drain_s=5.0)
+            _restore(saved, root)
+    finally:
+        if saved_backend is None:
+            os.environ.pop("MINIO_ERASURE_BACKEND", None)
+        else:
+            os.environ["MINIO_ERASURE_BACKEND"] = saved_backend
+        backend_mod.reset_backend()
+
+    # scaling acceptance: only a multi-core host can honestly show
+    # multi-loop throughput wins (loops time-slice one core otherwise)
+    one = results["cells"]["1"]["get"]
+    many = results["cells"][str(multi_loops)]["get"]
+    speedup = round(many["rps"] / one["rps"], 2) if one["rps"] else 0.0
+    p99_ratio = (
+        round(many["p99_ms"] / one["p99_ms"], 2)
+        if one["p99_ms"]
+        else 0.0
+    )
+    results["acceptance"] = {
+        "loops_compared": [1, multi_loops],
+        "get_rps_speedup": speedup,
+        "get_p99_ratio": p99_ratio,
+        "gate_applies": cores >= 2,
+    }
+    if cores >= 2 and multi_loops >= 2:
+        if speedup < 1.6:
+            raise RuntimeError(
+                f"multi-loop GET speedup {speedup} < 1.6x"
+            )
+        if p99_ratio > 1.5:
+            raise RuntimeError(
+                f"multi-loop p99 regressed {p99_ratio}x > 1.5x"
+            )
     return results
 
 
@@ -1914,7 +2380,11 @@ def main() -> None:
         action="store_true",
         help="run ONLY the request-plane concurrency sweep (1..64 "
         "keep-alive clients, GET+PUT p50/p99 + shed counts, async "
-        "event-loop plane vs threaded oracle) and print its JSON",
+        "event-loop plane vs threaded oracle) plus the connection-"
+        "storm tier (10k-class keep-alive conns via an asyncio "
+        "driver, slow-loris flood, pathological pipelining, tenant-"
+        "cap overload - all correctness-gated before timing, async@1 "
+        "vs async@N bit-identity) and print its JSON",
     )
     ap.add_argument(
         "--multichip",

@@ -57,6 +57,13 @@ DEFAULT_TARGETS = (
     # future lock added to the multi-process driver enters the graph
     "minio_tpu.cluster.harness",
     "minio_tpu.testgrid.engine",
+    # multi-loop request plane: the SharedBudget/TokenCounter admit
+    # path must stay lock-free (any mutex minted there would serialise
+    # every loop's admission), and the per-loop plane code must keep
+    # its remaining locks (PlaneStats aggregate, worker-pool stream
+    # registry) acyclic against the rest of the graph
+    "minio_tpu.server.admission",
+    "minio_tpu.server.aio",
 )
 
 _THIS_FILE = os.path.abspath(__file__)

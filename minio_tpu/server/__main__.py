@@ -390,7 +390,11 @@ def main(argv=None) -> int:
         srv.fault_disks = fault_disks
     # readiness gate: /minio/health/ready stays 503 until every
     # subsystem flips its flag, so a harness polls instead of sleeping
-    srv.boot_status = {"lock_plane": False, "boot": False}
+    srv.boot_status = {
+        "lock_plane": False,
+        "boot": False,
+        "server_loops": False,
+    }
     storage_rest = StorageRESTServer(pre_local, args.secret_key)
     srv.register_internode(STORAGE_PREFIX, storage_rest.handle)
     nslock, lock_rest, _lock_maint = build_lock_plane(
@@ -435,6 +439,11 @@ def main(argv=None) -> int:
         )
 
     srv.start()
+    # listener shards are up (async plane: every MINIO_TPU_SERVER_LOOPS
+    # loop accepting; readiness() additionally reports per-loop state)
+    srv.boot_status["server_loops"] = (
+        srv._plane is None or srv._plane.loops_ready()
+    )
     print(f"minio-tpu listening at {srv.endpoint} (bootstrapping)")
     if peers:
         peer_mod.verify_cluster(

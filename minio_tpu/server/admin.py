@@ -248,6 +248,11 @@ class AdminAPI:
         # MINIO_TPU_FAULT_INJECTION=1 (fault_disks is absent otherwise).
         if tail in ("fault/inject", "fault/clear", "fault/status"):
             return self._fault(method, tail, body)
+        # server-loop observability + chaos wedge (testgrid wedged_loop
+        # cell): status is read-only; the wedge rides the same
+        # MINIO_TPU_FAULT_INJECTION gate as disk faults
+        if tail in ("loops/status", "loops/wedge"):
+            return self._loops(method, tail, body)
         # bucket quota (admin SetBucketQuota / GetBucketQuotaConfig)
         if route == ("GET", "get-bucket-quota"):
             ol.get_bucket_info(_req(q, "bucket"))
@@ -410,6 +415,65 @@ class AdminAPI:
 
     # -- handlers ---------------------------------------------------------
 
+    def _loops(
+        self, method: str, tail: str, body: bytes
+    ) -> "tuple[int, bytes]":
+        """Server-loop control plane.
+
+        GET  loops/status  per-loop state/connections/inflight/sheds
+                           (available in every mode; threaded reports
+                           zero loops).
+        POST loops/wedge   {loop, seconds} - busy-spin one loop's
+                           thread so the chaos grid can prove a wedged
+                           loop degrades only its own shard.  Gated on
+                           MINIO_TPU_FAULT_INJECTION=1 like disk faults.
+        """
+        plane = getattr(self.s3, "_plane", None)
+        if (method, tail) == ("GET", "loops/status"):
+            doc = {
+                "mode": getattr(self.s3, "server_mode", "threaded"),
+            }
+            if plane is not None:
+                doc.update(plane.describe())
+            else:
+                doc.update(count=0, reuseport=False, per_loop=[])
+            return 200, _json(doc)
+        if (method, tail) != ("POST", "loops/wedge"):
+            raise S3Error("MethodNotAllowed", f"admin {method} /{tail}")
+        if not getattr(self.s3, "fault_disks", None):
+            raise S3Error(
+                "InvalidArgument",
+                "fault injection disabled: start the server with "
+                "MINIO_TPU_FAULT_INJECTION=1",
+            )
+        if plane is None:
+            raise S3Error(
+                "InvalidArgument",
+                "no async plane to wedge (MINIO_TPU_SERVER=threaded)",
+            )
+        doc = _body_json(body) if body.strip() else {}
+        try:
+            index = int(doc.get("loop", -1))
+            seconds = float(doc.get("seconds", 0.0))
+        except (TypeError, ValueError):
+            raise S3Error(
+                "InvalidArgument", "loop/seconds must be numeric"
+            ) from None
+        if seconds <= 0 or seconds > 300:
+            raise S3Error(
+                "InvalidArgument", "seconds must be in (0, 300]"
+            )
+        if not plane.wedge_loop(index, seconds):
+            raise S3Error(
+                "InvalidArgument",
+                f"no such loop {index} (have {len(plane.loops)})",
+            )
+        _log.info(
+            "server loop wedged",
+            extra=kv(loop=index, seconds=seconds),
+        )
+        return 200, _json({"wedged": index, "seconds": seconds})
+
     def _fault(
         self, method: str, tail: str, body: bytes
     ) -> "tuple[int, bytes]":
@@ -502,6 +566,26 @@ class AdminAPI:
             if getattr(self.s3, "plane_stats", None) is not None
             else {},
         }
+        # multi-loop front plane: shard count, listener strategy, and
+        # per-loop state (empty block in threaded mode)
+        plane = getattr(self.s3, "_plane", None)
+        doc["server_loops"] = (
+            plane.describe()
+            if plane is not None
+            else {"count": 0, "reuseport": False, "per_loop": []}
+        )
+        # shared admission budget: live per-tenant inflight plus the
+        # high-water mark each tenant's token counter ever reached -
+        # the out-of-process witness that the GLOBAL cap held exactly
+        # across loops (bench --concurrency asserts hwm <= cap here)
+        admission = getattr(self.s3, "admission", None)
+        if admission is not None:
+            doc["admission"] = {
+                "tenant_inflight": admission.tenant_inflight(),
+                "tenant_hwm": admission.budget.tenant_hwm(),
+                "select_inflight": admission.budget.select.value(),
+                "select_hwm": admission.budget.select.hwm,
+            }
         # tiered read cache: zero-filled when off, so the OBD shape is
         # stable across modes (cache/__init__.py read_cache_stats)
         from .. import cache as rcache

@@ -389,8 +389,14 @@ class S3Server:
     def shutdown(self, drain_s: float = 10.0) -> None:
         """Stop accepting, then drain in-flight requests up to
         ``drain_s`` (the reference's graceful shutdown,
-        cmd/http/server.go:116 request draining)."""
+        cmd/http/server.go:116 request draining).  Idempotent: SIGTERM
+        followed by an embedder's own shutdown() (or a double signal)
+        must not re-stop half-torn-down subsystems — every loop drains
+        exactly once."""
         self.draining = True
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
         if self._plane is not None:
             self._plane.stop(drain_s)
         if self._httpd:
@@ -445,8 +451,19 @@ class S3Server:
         doc = {"object_layer": self.object_layer is not None}
         if self.boot_status is not None:
             doc.update(self.boot_status)
+        plane = self._plane
+        if plane is not None:
+            # every server loop must be accepting before ready flips
+            doc["server_loops"] = plane.loops_ready()
         ok = all(doc.values()) and not self.draining
         doc["draining"] = self.draining
+        if plane is not None:
+            # per-loop detail rides after the ok computation (like
+            # "draining"): states are strings, not readiness gates
+            doc["loops"] = {
+                str(row["loop"]): row["state"]
+                for row in plane.describe()["per_loop"]
+            }
         return ok, _json.dumps(doc, sort_keys=True).encode()
 
     @property
@@ -775,7 +792,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
             self._error(s3errors.get("SlowDown"), path)
             return
-        self.s3.plane_stats.enter()
+        # multi-loop async plane: attribute the inflight gauge to the
+        # owning loop's lock-free cell (threaded oracle: loop=None)
+        _loop_ix = getattr(self, "_loop_index", None)
+        self.s3.plane_stats.enter(loop=_loop_ix)
         t0 = _time.monotonic()
         self._t_start = t0
         try:
@@ -801,7 +821,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._route_authed(path, query)
         finally:
             self.s3.release()
-            self.s3.plane_stats.leave()
+            self.s3.plane_stats.leave(loop=_loop_ix)
             if tenant is not None:
                 self.s3.admission.leave_tenant(tenant)
             # collectAPIStats analogue: every authed-path request lands

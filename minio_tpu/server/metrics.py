@@ -302,6 +302,48 @@ class Metrics:
                     for r in SHED_REASONS
                 ],
             )
+            loops = plane.get("loops") or []
+            if loops:
+                # multi-loop plane breakdown: one series per loop for
+                # every family (and per loop x reason for sheds), all
+                # zero-filled from the loop list so a scrape's shape
+                # never depends on which loop saw traffic
+                emit(
+                    "miniotpu_server_loop_connections", "gauge",
+                    "Open connections owned by each server loop",
+                    [
+                        ({"loop": str(s["loop"])},
+                         s["stage_depth"].get("parse", 0))
+                        for s in loops
+                    ],
+                )
+                emit(
+                    "miniotpu_server_loop_inflight_requests", "gauge",
+                    "Admitted requests executing per server loop",
+                    [
+                        ({"loop": str(s["loop"])}, s["inflight"])
+                        for s in loops
+                    ],
+                )
+                emit(
+                    "miniotpu_server_loop_handler_queue_depth", "gauge",
+                    "Requests queued for each loop's worker slice",
+                    [
+                        ({"loop": str(s["loop"])},
+                         s["stage_depth"].get("handler", 0))
+                        for s in loops
+                    ],
+                )
+                emit(
+                    "miniotpu_server_loop_shed_total", "counter",
+                    "Requests shed per server loop, by reason",
+                    [
+                        ({"loop": str(s["loop"]), "reason": r},
+                         s["shed"].get(r, 0))
+                        for s in loops
+                        for r in SHED_REASONS
+                    ],
+                )
         return ("\n".join(out) + "\n").encode()
 
     @staticmethod

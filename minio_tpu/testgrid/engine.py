@@ -25,6 +25,7 @@ Quorum invariants checked after every scenario:
 from __future__ import annotations
 
 import dataclasses
+import json
 import random
 import threading
 import time
@@ -694,6 +695,80 @@ def _step_expect_put(
         )
 
 
+def _step_wedge_loop(
+    ctx: _Ctx, node: int, loop_ix: int, seconds: float
+) -> None:
+    """Busy-spin one server loop's thread on node ``node`` via the
+    admin control plane (gated on MINIO_TPU_FAULT_INJECTION, like disk
+    faults).  Returns as soon as the wedge is scheduled."""
+    body = json.dumps({"loop": loop_ix, "seconds": seconds}).encode()
+    status, out = ctx.h.admin(node, "POST", "loops/wedge", body=body)
+    if status != 200:
+        raise AssertionError(
+            f"loops/wedge loop{loop_ix} on n{node + 1}: "
+            f"HTTP {status} {out}"
+        )
+
+
+def _step_assert_loops_serving(
+    ctx: _Ctx, node: int, count: int
+) -> None:
+    """Node ``node`` reports exactly ``count`` event loops, all in
+    state=serving."""
+    status, out = ctx.h.admin(node, "GET", "loops/status")
+    if status != 200:
+        raise AssertionError(
+            f"loops/status on n{node + 1}: HTTP {status} {out}"
+        )
+    states = [row.get("state") for row in out.get("per_loop", [])]
+    if out.get("count") != count or states != ["serving"] * count:
+        raise AssertionError(
+            f"n{node + 1} loops not all serving: "
+            f"count={out.get('count')} states={states}"
+        )
+
+
+def _step_probe_health_during_wedge(
+    ctx: _Ctx, node: int, within_s: float, probes: int = 3
+) -> None:
+    """While a wedge holds on one of node's loops, concurrent fresh
+    connections must still reach the control plane fast: at least one
+    of ``probes`` parallel loops/status calls answers within
+    ``within_s`` (in handoff mode consecutive accepts round-robin over
+    loops, so some probe always lands on a healthy loop)."""
+    time.sleep(0.5)  # let the wedge's scheduling grace elapse first
+    results: "list[tuple[int, float]]" = []
+    mu = threading.Lock()
+
+    def probe() -> None:
+        t0 = time.monotonic()
+        try:
+            status, _ = ctx.h.admin(node, "GET", "loops/status")
+        except OSError:
+            status = -1
+        with mu:
+            results.append((status, time.monotonic() - t0))
+
+    threads = [
+        threading.Thread(target=probe) for _ in range(probes)
+    ]
+    for t in threads:
+        t.start()
+        # sequential connects so handoff round-robin spreads the
+        # probes across loops deterministically
+        time.sleep(0.05)
+    for t in threads:
+        t.join(within_s + 30.0)
+    fast = [
+        el for st, el in results if st == 200 and el < within_s
+    ]
+    if not fast:
+        raise AssertionError(
+            f"no health probe on n{node + 1} answered within "
+            f"{within_s}s during the wedge: {results}"
+        )
+
+
 _VERBS = {
     "fault": _step_fault,
     "clear": _step_clear,
@@ -721,6 +796,9 @@ _VERBS = {
     "await_breaker": _step_await_breaker,
     "await_heal": _step_await_heal,
     "await_locks_drained": _step_await_locks_drained,
+    "wedge_loop": _step_wedge_loop,
+    "assert_loops_serving": _step_assert_loops_serving,
+    "probe_health_during_wedge": _step_probe_health_during_wedge,
 }
 
 

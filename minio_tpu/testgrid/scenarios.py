@@ -189,6 +189,38 @@ SELECT_HEAVY_MIX = Scenario(
     ),
 )
 
+# One event loop of a multi-loop node is artificially wedged (admin
+# loops/wedge busy-spins the loop thread, gated on fault injection
+# like disk faults): the blast radius must be that loop's shard ONLY.
+# The control plane keeps answering on fresh connections while the
+# wedge holds (handoff mode round-robins consecutive accepts over
+# loops, so probes deterministically reach a healthy loop), the rest
+# of the grid serves reads and writes throughout, and once the spin
+# releases every loop reports serving again.  The standard sweep then
+# proves no request was lost or torn behind the stall.
+WEDGED_LOOP = Scenario(
+    name="wedged_loop",
+    title="wedged event loop: one stalled loop degrades only its shard",
+    env=(
+        ("MINIO_TPU_SERVER", "async"),
+        ("MINIO_TPU_SERVER_LOOPS", "2"),
+        ("MINIO_TPU_SERVER_REUSEPORT", "off"),
+    ),
+    steps=(
+        ("assert_loops_serving", 0, 2),
+        ("assert_loops_serving", 1, 2),
+        ("assert_loops_serving", 2, 2),
+        # wedge the non-acceptor loop on n2: accepts keep flowing
+        ("wedge_loop", 1, 1, 4.0),
+        ("probe_health_during_wedge", 1, 2.5),
+        ("get_flood", "seed0", 6, 3),
+        ("put", 0, "during-wedge", 30_000, 201),
+        ("sleep", 1.0),
+        ("assert_loops_serving", 1, 2),
+        ("get_flood", "seed1", 3, 2),
+    ),
+)
+
 GRID = (
     DEAD_REMOTE_DISKS,
     SLOW_REMOTE_DISKS,
@@ -199,6 +231,7 @@ GRID = (
     HOT_KEY_CACHE_FLOOD,
     REPLICATION_LAG_CHURN,
     SELECT_HEAVY_MIX,
+    WEDGED_LOOP,
 )
 
 

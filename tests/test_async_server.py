@@ -40,6 +40,10 @@ class _Srv:
 
 def _boot(root, mode, **env):
     env = {"MINIO_TPU_SERVER": mode, **env}
+    # pin the loop count unless a test opts into multi-loop: the
+    # single-pool tests (exact shed counts, backlog=1 semantics)
+    # must not depend on the host's core count
+    env.setdefault("MINIO_TPU_SERVER_LOOPS", "1")
     saved = {k: os.environ.get(k) for k in env}
     for k, v in env.items():
         os.environ[k] = str(v)
@@ -494,3 +498,420 @@ def test_put_body_streams_to_codec(leakcheck, tmp_path):
     finally:
         srv.object_layer.put_object = orig
         _teardown(booted)
+
+
+# -- multi-loop plane (MINIO_TPU_SERVER_LOOPS) ----------------------------
+
+
+def test_loops1_bit_identical_to_multiloop(leakcheck, tmp_path):
+    """LOOPS=1 is today's plane verbatim and the bisection oracle for
+    the sharded one: the same object stored through 1 and 3 loops
+    round-trips to identical bytes and ETag, and the single-loop boot
+    takes the plain (non-SO_REUSEPORT) listener path."""
+    payload = _pay(1 << 19, seed=23)
+    got = {}
+    for loops in ("1", "3"):
+        booted = _boot(
+            tmp_path / f"l{loops}", "async",
+            MINIO_TPU_SERVER_LOOPS=loops,
+        )
+        try:
+            plane = booted.srv._plane
+            assert len(plane.loops) == int(loops)
+            if loops == "1":
+                assert plane.reuseport is False
+            c = S3Client(booted.srv.endpoint)
+            assert c.make_bucket("shard").status == 200
+            r = c.put_object("shard", "obj", payload)
+            assert r.status == 200
+            g = c.get_object("shard", "obj")
+            assert g.status == 200
+            got[loops] = (r.headers["etag"], g.body)
+        finally:
+            _teardown(booted)
+    assert got["1"][1] == payload
+    assert got["1"] == got["3"]
+
+
+@pytest.mark.parametrize("reuseport", ("auto", "off"))
+def test_multiloop_roundtrip_and_readiness(
+    leakcheck, tmp_path, reuseport
+):
+    """Both listener strategies (SO_REUSEPORT shards and the
+    round-robin handoff fallback) serve the full S3 path at LOOPS=3,
+    and readiness reports every loop serving."""
+    booted = _boot(
+        tmp_path, "async",
+        MINIO_TPU_SERVER_LOOPS="3",
+        MINIO_TPU_SERVER_REUSEPORT=reuseport,
+    )
+    try:
+        srv = booted.srv
+        plane = srv._plane
+        assert len(plane.loops) == 3
+        assert plane.reuseport is (reuseport == "auto")
+        ok, doc = srv.readiness()
+        assert ok
+        import json
+
+        parsed = json.loads(doc)
+        assert parsed["server_loops"] is True
+        assert parsed["loops"] == {
+            "0": "serving", "1": "serving", "2": "serving"
+        }
+        c = S3Client(srv.endpoint)
+        assert c.make_bucket("mlb").status == 200
+        body = _pay(96 * 1024, seed=5)
+        assert c.put_object("mlb", "obj", body).status == 200
+        # fresh connection per GET so accepts spread across loops
+        for _ in range(6):
+            g = S3Client(srv.endpoint).get_object("mlb", "obj")
+            assert g.status == 200 and g.body == body
+    finally:
+        _teardown(booted)
+
+
+def test_multiloop_pipelined_ordering(leakcheck, tmp_path):
+    """Per-connection pipelining is a per-loop affair: back-to-back
+    requests on one connection come back in order even when other
+    loops exist (a connection never migrates between loops)."""
+    booted = _boot(tmp_path, "async", MINIO_TPU_SERVER_LOOPS="2")
+    try:
+        c = S3Client(booted.srv.endpoint)
+        assert c.make_bucket("mpipe").status == 200
+        bodies = {f"o{i}": _pay(2048, seed=i) for i in (1, 2, 3)}
+        for k, v in bodies.items():
+            assert c.put_object("mpipe", k, v).status == 200
+        s = _connect(booted.srv)
+        try:
+            head = b"".join(
+                _signed_head(c, "GET", f"/mpipe/o{i}") for i in (1, 2, 3)
+            )
+            s.sendall(head)
+            f = s.makefile("rb")
+            for key in ("o1", "o2", "o3"):
+                status, _, body = _read_response(f)
+                assert status == 200
+                assert body == bodies[key]
+        finally:
+            s.close()
+    finally:
+        _teardown(booted)
+
+
+def test_wedged_loop_degrades_only_its_shard(leakcheck, tmp_path):
+    """Stalling one loop's thread (the chaos wedge behind the testgrid
+    wedged_loop cell) must not stall connections owned by other loops.
+    Handoff mode makes connection->loop placement deterministic
+    (round-robin from loop 0), so conn N lands on loop N%3."""
+    booted = _boot(
+        tmp_path, "async",
+        MINIO_TPU_SERVER_LOOPS="3",
+        MINIO_TPU_SERVER_REUSEPORT="off",
+    )
+    socks = []
+    try:
+        srv = booted.srv
+        c = S3Client(srv.endpoint)
+        assert c.make_bucket("wedge").status == 200
+        body = _pay(2048, seed=9)
+        assert c.put_object("wedge", "obj", body).status == 200
+
+        # three keep-alive connections, one per loop (round-robin);
+        # earlier client requests consumed rr slots, so detect which
+        # loop actually adopted each socket rather than assuming i%3
+        plane = srv._plane
+        placement = []
+        for i in range(3):
+            snap = [set(sl._conns) for sl in plane.loops]
+            s = _connect(srv)
+            socks.append(s)
+            s.sendall(_signed_head(c, "GET", "/wedge/obj"))
+            status, _, got = _read_response(s.makefile("rb"))
+            assert status == 200 and got == body
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                gained = [
+                    ix for ix, sl in enumerate(plane.loops)
+                    if set(sl._conns) - snap[ix]
+                ]
+                if len(gained) == 1:
+                    placement.append(gained[0])
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(
+                    f"conn {i} never registered with a loop: {gained}"
+                )
+        assert sorted(placement) == [0, 1, 2], placement
+
+        # wedge the loop owning socks[1]; the other two loops must
+        # keep serving their connections immediately
+        wedged = placement[1]
+        assert plane.wedge_loop(wedged, 3.0)
+        time.sleep(0.5)  # past the scheduling grace: the spin is live
+        for ix in (0, 2):
+            t0 = time.monotonic()
+            socks[ix].sendall(_signed_head(c, "GET", "/wedge/obj"))
+            status, _, got = _read_response(socks[ix].makefile("rb"))
+            assert status == 200 and got == body
+            assert time.monotonic() - t0 < 2.5, (
+                f"conn on loop {placement[ix]} stalled behind the "
+                f"wedge on loop {wedged}"
+            )
+        # the wedged loop's own connection answers only after the
+        # spin releases (response bytes flush through that loop)
+        t0 = time.monotonic()
+        socks[1].sendall(_signed_head(c, "GET", "/wedge/obj"))
+        status, _, got = _read_response(socks[1].makefile("rb"))
+        assert status == 200 and got == body
+    finally:
+        for s in socks:
+            s.close()
+        _teardown(booted)
+
+
+class _CountingBlocker:
+    """Wraps get_object for one key: counts concurrent handlers (the
+    ground truth the shared budget's hwm is checked against) and parks
+    them until released."""
+
+    def __init__(self, ol, key):
+        self.ol = ol
+        self.key = key
+        self.release = threading.Event()
+        self._mu = threading.Lock()
+        self.concurrent = 0
+        self.max_concurrent = 0
+        self._orig = ol.get_object
+
+    def install(self):
+        def counting_get(bucket, object_name, writer, *args, **kw):
+            if object_name == self.key:
+                with self._mu:
+                    self.concurrent += 1
+                    self.max_concurrent = max(
+                        self.max_concurrent, self.concurrent
+                    )
+                try:
+                    assert self.release.wait(30.0), "never released"
+                finally:
+                    with self._mu:
+                        self.concurrent -= 1
+            return self._orig(bucket, object_name, writer, *args, **kw)
+
+        self.ol.get_object = counting_get
+
+    def uninstall(self):
+        self.release.set()
+        self.ol.get_object = self._orig
+
+
+def test_multiloop_tenant_cap_exact_across_loops(leakcheck, tmp_path):
+    """The global per-tenant cap holds EXACTLY across loops under a
+    concurrent flood: with cap=4 and 12 parallel GETs spread over 3
+    loops, exactly 4 park in handlers, the rest shed 503 tenant, and
+    the shared budget's high-water mark never exceeds the cap."""
+    CAP, FLOOD = 4, 12
+    booted = _boot(
+        tmp_path, "async",
+        MINIO_TPU_SERVER_LOOPS="3",
+        MINIO_TPU_SERVER_WORKERS="18",
+        MINIO_TPU_SERVER_BACKLOG="30",
+        MINIO_TPU_TENANT_MAX_INFLIGHT=str(CAP),
+    )
+    srv = booted.srv
+    blocker = None
+    threads = []
+    try:
+        c = S3Client(srv.endpoint)
+        assert _retry_503(c.make_bucket, "cap").status == 200
+        assert _retry_503(
+            c.put_object, "cap", "slow", _pay(512)
+        ).status == 200
+
+        blocker = _CountingBlocker(srv.object_layer, "slow")
+        blocker.install()
+        results = {}
+
+        def fetch(tag):
+            # one shot, no retry: the flood itself is the assertion
+            results[tag] = S3Client(srv.endpoint).get_object(
+                "cap", "slow"
+            )
+
+        for i in range(FLOOD):
+            threads.append(
+                threading.Thread(target=fetch, args=(i,))
+            )
+            threads[-1].start()
+        # every request reached a verdict: parked in a handler or shed
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            parked = blocker.concurrent
+            shed = srv.plane_stats.snapshot()["shed"]["tenant"]
+            if parked + shed >= FLOOD:
+                break
+            time.sleep(0.05)
+        assert blocker.concurrent == CAP, (
+            f"cap not saturated: {blocker.concurrent}/{CAP} parked"
+        )
+        blocker.release.set()
+        for t in threads:
+            t.join(30.0)
+        statuses = sorted(r.status for r in results.values())
+        assert statuses.count(200) == CAP
+        assert statuses.count(503) == FLOOD - CAP
+        for r in results.values():
+            if r.status == 503:
+                assert r.error_code == "SlowDown"
+        # the budget's own witness: admitted concurrency never crossed
+        # the cap on any interleaving (TokenCounter invariant)
+        hwm = srv.admission.budget.tenant_hwm()
+        assert hwm.get("minioadmin", 0) <= CAP
+        assert blocker.max_concurrent == CAP
+    finally:
+        if blocker is not None:
+            blocker.uninstall()
+        for t in threads:
+            t.join(5.0)
+        _teardown(booted)
+
+
+def test_multiloop_shutdown_drains_every_loop(leakcheck, tmp_path):
+    """S3Server.shutdown with N loops: stops accepting, waits for the
+    in-flight request on whichever loop owns it, and a second call is
+    an idempotent no-op.  Every loop lands in state=stopped."""
+    booted = _boot(tmp_path, "async", MINIO_TPU_SERVER_LOOPS="2")
+    srv = booted.srv
+    blocker = None
+    t = None
+    try:
+        c = S3Client(srv.endpoint)
+        assert c.make_bucket("drain").status == 200
+        assert c.put_object("drain", "slow", _pay(1024)).status == 200
+        blocker = _BlockingLayer(srv.object_layer, "slow")
+        blocker.install()
+        results = {}
+
+        def fetch():
+            results["r"] = S3Client(srv.endpoint).get_object(
+                "drain", "slow"
+            )
+
+        t = threading.Thread(target=fetch)
+        t.start()
+        assert blocker.entered.wait(10.0)
+
+        def release_soon():
+            time.sleep(0.5)
+            blocker.release.set()
+
+        rel = threading.Thread(target=release_soon)
+        rel.start()
+        srv.shutdown(drain_s=10.0)
+        rel.join(5.0)
+        t.join(10.0)
+        assert results["r"].status == 200
+        plane = srv._plane
+        assert [sl.state for sl in plane.loops] == ["stopped"] * 2
+        t0 = time.monotonic()
+        srv.shutdown(drain_s=10.0)  # idempotent, returns immediately
+        assert time.monotonic() - t0 < 1.0
+        ok, _doc = srv.readiness()
+        assert not ok  # draining servers are not ready
+    finally:
+        if blocker is not None:
+            blocker.uninstall()
+        if t is not None:
+            t.join(5.0)
+        _teardown(booted)
+
+
+# -- lock-free shared budget ----------------------------------------------
+
+
+def test_shared_budget_lock_free():
+    """The MTPU3xx auditor proxies the admission module's threading:
+    exercising the SharedBudget/TokenCounter fast path from many
+    threads must mint ZERO audited locks beyond the PlaneStats
+    aggregate mutex (constructed once, never touched per-admit by the
+    per-loop path) — and leave the lock graph clean."""
+    from minio_tpu.analysis.lockorder import LockOrderAuditor
+    from minio_tpu.server import admission as adm_mod
+
+    aud = LockOrderAuditor(targets=("minio_tpu.server.admission",))
+    with aud.installed():
+        stats = adm_mod.PlaneStats()
+        baseline = aud._serial  # PlaneStats' one aggregate mutex
+        assert baseline >= 1
+        cells = [stats.add_loop() for _ in range(3)]
+        budget = adm_mod.SharedBudget()
+        errors = []
+
+        def hammer(ix):
+            try:
+                cell = cells[ix % 3]
+                for r in range(400):
+                    tc = budget.tenant(f"t{r % 4}")
+                    if tc.try_acquire(8):
+                        cell.enter()
+                        cell.shed_inc("tenant")
+                        cell.leave()
+                        tc.release()
+                    if budget.select.try_acquire(4):
+                        budget.select.release()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert not errors
+        # the hot path minted no locks: lock-free to the auditor
+        assert aud._serial == baseline
+        for name, v in budget.tenant_values().items():
+            assert v == 0, f"leaked slot on {name}"
+        for name, hwm in budget.tenant_hwm().items():
+            assert hwm <= 8, f"cap exceeded on {name}: {hwm}"
+        assert budget.select.hwm <= 4
+    assert aud.report() == []
+
+
+def test_token_counter_exact_under_contention():
+    """TokenCounter's one-sided invariant, empirically: with LIMIT=3
+    and 8 threads spinning acquire/release, the *independently
+    measured* concurrent-holder count never exceeds the limit (the
+    counter may over-shed, never over-admit)."""
+    from minio_tpu.server.admission import TokenCounter
+
+    LIMIT, THREADS, ROUNDS = 3, 8, 500
+    tc = TokenCounter()
+    mu = threading.Lock()
+    holders = {"cur": 0, "max": 0}
+    admitted = {"n": 0}
+
+    def worker():
+        for _ in range(ROUNDS):
+            if tc.try_acquire(LIMIT):
+                with mu:
+                    holders["cur"] += 1
+                    holders["max"] = max(holders["max"], holders["cur"])
+                    admitted["n"] += 1
+                with mu:
+                    holders["cur"] -= 1
+                tc.release()
+
+    threads = [threading.Thread(target=worker) for _ in range(THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert holders["max"] <= LIMIT
+    assert tc.hwm <= LIMIT
+    assert tc.value() == 0
+    assert admitted["n"] > 0  # the cap gate did admit traffic

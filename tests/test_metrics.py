@@ -667,3 +667,106 @@ def test_live_server_plane_families(server, client):
     fam = get_family(families, "miniotpu_server_shed_total")
     reasons = {lab["reason"] for _n, lab, _v in fam["samples"]}
     assert reasons == set(SHED_REASONS)
+
+
+def test_server_loop_families_render_unit():
+    """A multi-loop plane snapshot fans out into the four per-loop
+    families, one series per loop (x reason for sheds), zero-filled
+    from the loop list - a scrape's shape never depends on which loop
+    saw traffic.  Single-loop-free snapshots omit the families."""
+    from minio_tpu.server.admission import SHED_REASONS, PlaneStats
+
+    stats = PlaneStats()
+    cells = [stats.add_loop() for _ in range(2)]
+    cells[0].register_stage("parse", lambda: 5)   # open connections
+    cells[0].register_stage("handler", lambda: 2)
+    cells[1].register_stage("parse", lambda: 0)
+    cells[1].register_stage("handler", lambda: 0)
+    cells[0].enter()
+    cells[0].shed_inc("tenant")
+    doc = Metrics().render(plane=stats.snapshot()).decode()
+    families = parse_exposition(doc)
+
+    fam = get_family(families, "miniotpu_server_loop_connections")
+    assert fam["type"] == "gauge"
+    conns = {lab["loop"]: v for _n, lab, v in fam["samples"]}
+    assert conns == {"0": 5.0, "1": 0.0}
+    fam = get_family(families, "miniotpu_server_loop_inflight_requests")
+    infl = {lab["loop"]: v for _n, lab, v in fam["samples"]}
+    assert infl == {"0": 1.0, "1": 0.0}
+    fam = get_family(
+        families, "miniotpu_server_loop_handler_queue_depth"
+    )
+    depths = {lab["loop"]: v for _n, lab, v in fam["samples"]}
+    assert depths == {"0": 2.0, "1": 0.0}
+    fam = get_family(families, "miniotpu_server_loop_shed_total")
+    assert fam["type"] == "counter"
+    sheds = {
+        (lab["loop"], lab["reason"]): v for _n, lab, v in fam["samples"]
+    }
+    assert set(sheds) == {
+        (lp, r) for lp in ("0", "1") for r in SHED_REASONS
+    }  # zero-filled per loop x reason
+    assert sheds[("0", "tenant")] == 1.0
+    assert sum(sheds.values()) == 1.0
+
+    # the aggregate view still sums the cells (oracle compatibility)
+    fam = get_family(families, "miniotpu_server_inflight_requests")
+    assert fam["samples"][0][2] == 1.0
+
+    # a plane with no loop cells does not emit the per-loop families
+    flat = parse_exposition(
+        Metrics().render(plane=PlaneStats().snapshot()).decode()
+    )
+    assert "miniotpu_server_loop_connections" not in flat
+
+
+def test_live_server_loop_families():
+    """A live async multi-loop server's scrape carries all four
+    per-loop families with a series for every configured loop."""
+    import os
+    import tempfile
+
+    from minio_tpu.server.admission import SHED_REASONS
+
+    env = {"MINIO_TPU_SERVER": "async", "MINIO_TPU_SERVER_LOOPS": "2"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    srv = None
+    try:
+        with tempfile.TemporaryDirectory() as root:
+            disks = [
+                XLStorage(os.path.join(root, f"d{i}")) for i in range(4)
+            ]
+            ol = ErasureObjects(disks, block_size=4096)
+            srv = S3Server(ol, address="127.0.0.1:0").start()
+            c = S3Client(srv.endpoint)
+            assert c.make_bucket("loopm").status == 200
+            assert c.put_object("loopm", "o", b"y" * 4096).status == 200
+            families = parse_exposition(_scrape(c))
+            for name in (
+                "miniotpu_server_loop_connections",
+                "miniotpu_server_loop_inflight_requests",
+                "miniotpu_server_loop_handler_queue_depth",
+            ):
+                fam = get_family(families, name)
+                loops = {lab["loop"] for _n, lab, _v in fam["samples"]}
+                assert loops == {"0", "1"}, (name, loops)
+            fam = get_family(families, "miniotpu_server_loop_shed_total")
+            cells = {
+                (lab["loop"], lab["reason"])
+                for _n, lab, _v in fam["samples"]
+            }
+            assert cells == {
+                (lp, r) for lp in ("0", "1") for r in SHED_REASONS
+            }
+            srv.shutdown()
+            srv = None
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
