@@ -1,6 +1,6 @@
 """minio_tpu.analysis: project-native static analysis.
 
-Five passes over the codebase's invariants (the Python/JAX stand-ins
+Six passes over the codebase's invariants (the Python/JAX stand-ins
 for the go-vet / staticcheck / race-detector triad the reference MinIO
 leans on):
 
@@ -14,10 +14,15 @@ leans on):
 * ``deviceflow``      — interprocedural device-dataflow rules
   MTPU501-505 (use-after-donate, D2H escapes, thread-boundary
   captures, call-graph-deep blocking-under-async, registry drift)
-  over the ``callgraph`` module's whole-tree call graph.
+  over the ``callgraph`` module's whole-tree call graph;
+* ``lifecycle``       — interprocedural resource-lifecycle rules
+  MTPU601-606 (leaked/double/unprotected acquires, use-after-
+  transfer, resource-registry drift, config-knob drift) over the
+  same call graph and the ``resource_registry`` table.
 
 The file-walking passes share one mtime-keyed AST cache
-(``astcache.CACHE``) so a five-pass run parses each file exactly once.
+(``astcache.CACHE``) so a six-pass run parses each file exactly once,
+and the deviceflow/lifecycle passes share one call-graph build.
 
 Run ``python -m minio_tpu.analysis`` (tier-1 runs the same passes via
 tests/test_analysis.py).  Suppress a deliberate violation with
@@ -181,6 +186,50 @@ def run_deviceflow(
     return findings
 
 
+def run_lifecycle_report(
+    paths: "list[str] | None" = None,
+    restrict: "set[str] | None" = None,
+    graph=None,
+):
+    """Lifecycle pass (MTPU601-606) with its callgraph report.
+
+    Mirrors ``run_deviceflow_report``: whole-set analysis (release
+    credit is an interprocedural fact), ``restrict`` limits which
+    files' findings are REPORTED for sound --changed-only, and
+    ``graph`` lets a caller share the deviceflow pass's call-graph
+    build.
+    """
+    from .astcache import CACHE
+    from .lifecycle import analyze_sources
+
+    sources = CACHE.load(iter_py_files(paths))
+    report = analyze_sources(sources, graph=graph)
+    by_path: "dict[str, list[Finding]]" = {}
+    for f in report.findings:
+        by_path.setdefault(f.path, []).append(f)
+    findings = list(report.findings)
+    for rel, mod in sources.items():
+        findings.extend(
+            unused_suppressions(
+                rel, mod.text, by_path.get(rel, []), prefixes=("MTPU6",)
+            )
+        )
+    lines = {rel: mod.lines for rel, mod in sources.items()}
+    findings = filter_suppressed(findings, lines)
+    if restrict is not None:
+        findings = [f for f in findings if f.path in restrict]
+    return sorted(findings, key=Finding.sort_key), report
+
+
+def run_lifecycle(
+    paths: "list[str] | None" = None,
+    restrict: "set[str] | None" = None,
+) -> "list[Finding]":
+    """Interprocedural resource-lifecycle checks (MTPU601-606)."""
+    findings, _ = run_lifecycle_report(paths, restrict)
+    return findings
+
+
 def run_abi() -> "list[Finding]":
     """ctypes/ABI contract checks over the native FFI seam."""
     from . import abi_contracts
@@ -242,6 +291,7 @@ def run_all_timed(
         timed("contracts", run_contracts)
     if "locks" not in skip:
         timed("locks", run_locks)
+    shared_graph = None
     if "deviceflow" not in skip:
         t0 = time.monotonic()
         # a restrict set implies whole-tree analysis (the closure was
@@ -253,6 +303,18 @@ def run_all_timed(
         findings.extend(df)
         pass_seconds["deviceflow"] = round(time.monotonic() - t0, 3)
         callgraph_stats = report.graph.stats()
+        shared_graph = report.graph
+    if "lifecycle" not in skip:
+        t0 = time.monotonic()
+        lc, lc_report = run_lifecycle_report(
+            None if deviceflow_restrict is not None else paths,
+            restrict=deviceflow_restrict,
+            graph=shared_graph,
+        )
+        findings.extend(lc)
+        pass_seconds["lifecycle"] = round(time.monotonic() - t0, 3)
+        if callgraph_stats is None:
+            callgraph_stats = lc_report.graph.stats()
     return (
         sorted(findings, key=Finding.sort_key),
         pass_seconds,

@@ -7,12 +7,13 @@ tests/test_analysis.py.
 ``--changed-only`` narrows the run to what the working tree actually
 touches (vs HEAD, plus untracked files): lint runs over just the
 changed .py files, and the tree-global passes (contracts, abi, locks)
-run only when a file they audit changed.  The deviceflow pass is
-interprocedural, so prefix gating would be UNSOUND for it — editing a
-callee can create or remove a finding in a caller — instead it always
-analyzes the whole tree and reports findings for the reverse-dependency
-closure of the changed files over the call graph.  This keeps the gate
-fast as the tree grows without weakening a full run.
+run only when a file they audit changed.  The deviceflow and lifecycle
+passes are interprocedural, so prefix gating would be UNSOUND for them
+— editing a callee can create or remove a finding in a caller —
+instead they always analyze the whole tree and report findings for the
+reverse-dependency closure of the changed files over the call graph.
+This keeps the gate fast as the tree grows without weakening a full
+run.
 
 ``--json`` emits ``{"findings": [...], "passes": {pass: seconds},
 "callgraph": {nodes, edges, boundary_edges, seconds}}`` so analyzer
@@ -57,7 +58,7 @@ PASS_TRIGGER_PREFIXES = {
     ),
 }
 
-PASSES = ("lint", "abi", "contracts", "locks", "deviceflow")
+PASSES = ("lint", "abi", "contracts", "locks", "deviceflow", "lifecycle")
 
 
 def _changed_files(repo_root: str) -> "set[str]":
@@ -89,7 +90,8 @@ def main(argv: "list[str] | None" = None) -> int:
         prog="python -m minio_tpu.analysis",
         description="minio-tpu project-native static analysis "
         "(hot-path lint, ABI contracts, kernel contracts, lock-order "
-        "audit, interprocedural device-dataflow)",
+        "audit, interprocedural device-dataflow, resource-lifecycle "
+        "must-release)",
         epilog="directories named "
         + ", ".join(EXCLUDED_DIR_NAMES)
         + " are always excluded from file-walking passes",
@@ -153,13 +155,15 @@ def main(argv: "list[str] | None" = None) -> int:
             if not any(p.startswith(prefixes) for p in changed):
                 skip.add(pass_name)
         if lint_paths:
-            # deviceflow findings are interprocedural: analyze the
-            # whole tree, report for the changed files PLUS everything
-            # that transitively calls into them (prefix gating would
-            # silently skip a caller whose callee just changed)
+            # deviceflow/lifecycle findings are interprocedural:
+            # analyze the whole tree, report for the changed files PLUS
+            # everything that transitively calls into them (prefix
+            # gating would silently skip a caller whose callee just
+            # changed); both passes share this one closure
             deviceflow_restrict = _reverse_closure(set(lint_paths))
         else:
             skip.add("deviceflow")
+            skip.add("lifecycle")
 
     findings, pass_seconds, callgraph_stats = run_all_timed(
         paths=paths,

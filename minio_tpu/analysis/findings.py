@@ -148,6 +148,45 @@ RULES: "dict[str, str]" = {
         "does not have, or the tree declares one the registry misses "
         "(the MTPU403 orphan-check discipline for dataflow facts)"
     ),
+    "MTPU601": (
+        "resource lifecycle: leaked acquire — a registered resource "
+        "(staging-ledger reservation, admission token, parity ref, "
+        "io-pool future, rw-lock, fault hang) is acquired and a path "
+        "reaches function exit without a matching release or a "
+        "registered ownership transfer (the defer-less leak class: one "
+        "missed release starves the device budget or wedges admission)"
+    ),
+    "MTPU602": (
+        "resource lifecycle: double release — the same acquisition is "
+        "released twice on one path (over-release corrupts the ledger "
+        "or admission counters as silently as a leak)"
+    ),
+    "MTPU603": (
+        "resource lifecycle: unprotected hold — an acquired resource is "
+        "held across a raisable call without a try/finally (or `with`) "
+        "guaranteeing its release; an exception on that call leaks the "
+        "resource even though the straight-line path releases it"
+    ),
+    "MTPU604": (
+        "resource lifecycle: use after ownership transfer — a resource "
+        "handed to a registered transfer seam (async handle, band "
+        "adopt, caller-owned return) is released or re-used afterwards "
+        "by the original holder"
+    ),
+    "MTPU605": (
+        "resource lifecycle: registry drift — resource_registry names "
+        "an acquire/release/transfer function the call graph does not "
+        "have, or an acquire-shaped API in a registered resource module "
+        "has no registry entry (the MTPU505 discipline for lifecycle "
+        "facts)"
+    ),
+    "MTPU606": (
+        "config-knob drift: a MINIO_TPU_* environment knob is read "
+        "without a minio_tpu/config/knobs.py registry entry, or a "
+        "registered knob is missing its README mention, or a registry "
+        "entry names a knob nothing reads (docs, defaults, and code "
+        "move together)"
+    ),
 }
 
 

@@ -980,9 +980,14 @@ class TpuBackend(CodecBackend):
                 group,
                 reserved,
             ) = handle.payload
-            digests = np.asarray(digests_d)
-            _record_d2h("data", digests.nbytes)
-            _stage_release(reserved)
+            # the reservation must drop even when the digest D2H
+            # throws (device reset mid-drain): an exception here must
+            # not strand staging-ledger bytes for the process lifetime
+            try:
+                digests = np.asarray(digests_d)
+                _record_d2h("data", digests.nbytes)
+            finally:
+                _stage_release(reserved)
             result = (
                 digests,
                 _SubchunkParityRef(

@@ -540,7 +540,10 @@ class AdminAPI:
             "fault schedule injected",
             extra=kv(api=str(api), disks=len(matched)),
         )
-        return 200, _json({"injected": sorted(matched)})
+        # the parked hang is the product here: an injected fault
+        # schedule deliberately outlives this request and is released
+        # by a later POST fault/clear, never by this frame
+        return 200, _json({"injected": sorted(matched)})  # noqa: MTPU601,MTPU603
 
     def _health_info_local(self, ol) -> dict:
         """This node's OBD document: platform + memory + per-local-

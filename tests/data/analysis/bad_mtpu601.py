@@ -1,0 +1,12 @@
+"""MTPU601 fixture: an admitted tenant token leaks on the error-exit
+path — the 5xx early return skips leave_tenant."""
+
+
+def shed_leaks(adm, tenant):
+    if not adm.try_enter_tenant(tenant):
+        return 503
+    code = len(tenant)
+    if code >= 500:
+        return code  # VIOLATION: MTPU601
+    adm.leave_tenant(tenant)
+    return code

@@ -1,0 +1,10 @@
+"""MTPU602 fixture: the write lock is released twice on the success
+path — the second release_write corrupts the writer count."""
+
+
+def toggle(ns, key):
+    if not ns.acquire_write(key):
+        return False
+    ns.release_write(key)
+    ns.release_write(key)  # VIOLATION: MTPU602
+    return True

@@ -1,0 +1,10 @@
+"""MTPU603 fixture: the namespace write lock is held across a raisable
+disk write with nothing guaranteeing release_write on the throw."""
+
+
+def persist(ns, disk, key):
+    if not ns.acquire_write(key):
+        return False
+    disk.write_meta(key)  # VIOLATION: MTPU603
+    ns.release_write(key)
+    return True

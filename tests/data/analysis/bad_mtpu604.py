@@ -1,0 +1,8 @@
+"""MTPU604 fixture: the io-future handle is waited on after adopt()
+transferred its completion ownership to the parity band."""
+
+
+def hand_off(pool, band, req):
+    fut = pool.submit(req)
+    band.adopt(fut)
+    return fut.wait()  # VIOLATION: MTPU604

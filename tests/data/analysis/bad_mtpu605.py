@@ -1,0 +1,6 @@
+"""MTPU605 fixture: an acquire-shaped def in a registered resource
+module (dsync scope) that resource_registry.py does not know."""
+
+
+def acquire_region(ns, key):  # VIOLATION: MTPU605
+    return (ns, key)

@@ -1,0 +1,18 @@
+"""MTPU606 fixture: MINIO_TPU_* env reads that bypass the knob
+registry — one exact knob and one dynamic prefix family."""
+
+import os
+
+
+def read_unregistered():
+    v = os.getenv("MINIO_TPU_FIXTURE_UNREGISTERED")  # VIOLATION: MTPU606
+    return v
+
+
+def read_registered():
+    return os.getenv("MINIO_TPU_FIXTURE_REGISTERED", "1")
+
+
+def read_unknown_family(kind):
+    v = os.environ.get(f"MINIO_TPU_FIXTURE_FAM_{kind}")  # VIOLATION: MTPU606
+    return v

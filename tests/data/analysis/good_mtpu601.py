@@ -1,0 +1,14 @@
+"""MTPU601 good twin: try/finally guarantees leave_tenant on both the
+success and the error-exit path."""
+
+
+def shed_balanced(adm, tenant):
+    if not adm.try_enter_tenant(tenant):
+        return 503
+    try:
+        code = len(tenant)
+        if code >= 500:
+            return code
+        return 200
+    finally:
+        adm.leave_tenant(tenant)

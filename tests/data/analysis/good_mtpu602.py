@@ -1,0 +1,8 @@
+"""MTPU602 good twin: exactly one release_write per acquire_write."""
+
+
+def toggle(ns, key):
+    if not ns.acquire_write(key):
+        return False
+    ns.release_write(key)
+    return True

@@ -1,0 +1,12 @@
+"""MTPU603 good twin: the try/finally makes the raisable disk write
+safe — release_write runs even when it throws."""
+
+
+def persist(ns, disk, key):
+    if not ns.acquire_write(key):
+        return False
+    try:
+        disk.write_meta(key)
+    finally:
+        ns.release_write(key)
+    return True

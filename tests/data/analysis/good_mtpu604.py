@@ -1,0 +1,8 @@
+"""MTPU604 good twin: after adopt() the frame never touches the
+future again — the band owns its completion."""
+
+
+def hand_off(pool, band, req):
+    fut = pool.submit(req)
+    band.adopt(fut)
+    return band

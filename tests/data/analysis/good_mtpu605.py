@@ -1,0 +1,7 @@
+"""MTPU605 good twin: the same module shape but the acquire-shaped
+name is one the registry's def table already covers."""
+
+
+class _RegionLock:
+    def acquire_read(self, key):
+        return key is not None

@@ -1,0 +1,12 @@
+"""MTPU606 good twin: every env read resolves through the registry —
+the exact knob and the prefix family are both registered."""
+
+import os
+
+
+def read_registered():
+    return os.getenv("MINIO_TPU_FIXTURE_REGISTERED", "1")
+
+
+def read_family(kind):
+    return os.environ.get(f"MINIO_TPU_FIXTURE_FAM_{kind}")

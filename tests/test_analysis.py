@@ -1074,6 +1074,325 @@ def test_astcache_reparses_only_on_mtime_change(tmp_path):
     assert third.text == "x = 2\n"
 
 
+# -- lifecycle pass (MTPU601-606) ---------------------------------------
+
+from minio_tpu.analysis import lifecycle  # noqa: E402
+from minio_tpu.analysis.resource_registry import Registry  # noqa: E402
+
+# lifecycle matching is scope-gated, so every fixture is analyzed under
+# a rel path inside the resource class it exercises
+LIFECYCLE_REL_OVERRIDE = {
+    "bad_mtpu601.py": "minio_tpu/server/bad_mtpu601.py",
+    "good_mtpu601.py": "minio_tpu/server/good_mtpu601.py",
+    "bad_mtpu602.py": "minio_tpu/dsync/bad_mtpu602.py",
+    "good_mtpu602.py": "minio_tpu/dsync/good_mtpu602.py",
+    "bad_mtpu603.py": "minio_tpu/dsync/bad_mtpu603.py",
+    "good_mtpu603.py": "minio_tpu/dsync/good_mtpu603.py",
+    "bad_mtpu604.py": "minio_tpu/parallel/bad_mtpu604.py",
+    "good_mtpu604.py": "minio_tpu/parallel/good_mtpu604.py",
+    "bad_mtpu605.py": "minio_tpu/dsync/bad_mtpu605.py",
+    "good_mtpu605.py": "minio_tpu/dsync/good_mtpu605.py",
+}
+
+
+def _lifecycle_fixture(name):
+    """Lifecycle-analyze one fixture under its in-scope rel path,
+    noqa-filtered as the CLI would."""
+    lines = _fixture_lines(name)
+    rel = LIFECYCLE_REL_OVERRIDE.get(
+        name, f"tests/data/analysis/{name}"
+    )
+    text = "\n".join(lines) + "\n"
+    rep = lifecycle.analyze_sources({rel: parse_source(rel, text)})
+    return filter_suppressed(rep.findings, {rel: lines})
+
+
+def _knobs_module_source(*, family):
+    lines = [
+        "KNOBS = {",
+        '    "MINIO_TPU_FIXTURE_REGISTERED": ("1", "fixture knob"),',
+        "}",
+        "PREFIX_KNOBS = {",
+    ]
+    if family:
+        lines.append(
+            '    "MINIO_TPU_FIXTURE_FAM_": ("", "fixture family"),'
+        )
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def _knob_fixture(name, *, family):
+    """MTPU606-check one fixture against a synthetic knob registry
+    (and a README stub mentioning every registered name)."""
+    lines = _fixture_lines(name)
+    rel = f"tests/data/analysis/{name}"
+    sources = {
+        rel: parse_source(rel, "\n".join(lines) + "\n"),
+        lifecycle.KNOBS_REL: parse_source(
+            lifecycle.KNOBS_REL, _knobs_module_source(family=family)
+        ),
+    }
+    found = lifecycle.check_knobs(
+        sources,
+        readme_text=(
+            "MINIO_TPU_FIXTURE_REGISTERED MINIO_TPU_FIXTURE_FAM_"
+        ),
+    )
+    return filter_suppressed(found, {rel: lines})
+
+
+@pytest.mark.parametrize(
+    "name", [f"bad_mtpu60{i}.py" for i in range(1, 6)]
+)
+def test_bad_lifecycle_fixture_exact_findings(name):
+    expected = _expected_markers(name)
+    assert expected, f"{name} declares no VIOLATION markers"
+    got = {(f.rule, f.line) for f in _lifecycle_fixture(name)}
+    assert got == expected
+
+
+@pytest.mark.parametrize(
+    "name", [f"good_mtpu60{i}.py" for i in range(1, 6)]
+)
+def test_good_lifecycle_fixture_clean(name):
+    found = _lifecycle_fixture(name)
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_bad_knob_fixture_exact_findings():
+    expected = _expected_markers("bad_mtpu606.py")
+    assert expected
+    found = _knob_fixture("bad_mtpu606.py", family=False)
+    got = {(f.rule, f.line) for f in found}
+    assert got == expected, "\n".join(f.render() for f in found)
+
+
+def test_good_knob_fixture_clean():
+    found = _knob_fixture("good_mtpu606.py", family=True)
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_tree_lifecycle_clean():
+    """minio_tpu/ carries zero unsuppressed lifecycle findings."""
+    found = analysis.run_lifecycle()
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_mtpu605_flags_registered_def_missing_from_module():
+    """Drift direction 1: the registry pins _RWLock.acquire_read (and
+    friends) to dsync/namespace.py; a namespace.py that lost them must
+    fire MTPU605 for each missing def — without direction-2 noise for
+    the def that survives under its registered name."""
+    rel = "minio_tpu/dsync/namespace.py"
+    src = (
+        "class _RWLock:\n"
+        "    def acquire_write(self, key):\n"
+        "        return True\n"
+    )
+    found = lifecycle.analyze_sources(
+        {rel: parse_source(rel, src)}
+    ).findings
+    assert found, "a gutted namespace.py must not analyze clean"
+    assert {f.rule for f in found} == {"MTPU605"}
+    gone = ("acquire_read", "release_read", "release_write")
+    for name in gone:
+        assert any(
+            f"_RWLock.{name}" in f.message for f in found
+        ), name
+    assert not any("acquire_write" in f.message for f in found)
+
+
+def test_registry_resolves_every_def_in_tree_graph(tree_graph):
+    """Every (module, qname) the resource registry names resolves to
+    a call-graph def node — the registry cannot drift from the code
+    (same closure discipline as the MTPU204 coverage test)."""
+    _, graph = tree_graph
+    missing = [
+        (rel, qname)
+        for res in Registry.default().resources
+        for rel, qname in res.defs
+        if graph.lookup(rel, qname) is None
+    ]
+    assert missing == []
+
+
+def test_mtpu601_fires_on_seeded_backend_canary():
+    """Canary: a copy of the REAL codec/backend.py whose GET sub-chunk
+    path drops its finally-release strands the staging reservation —
+    caught with exact rule ids and lines (the unprotected hold and the
+    leaking exit)."""
+    rel = "minio_tpu/codec/backend.py"
+    src = _read_tree_source(rel)
+    target = (
+        "        finally:\n"
+        "            _stage_release(reserved)\n"
+        "        return np.concatenate(parts, axis=-1), ok\n"
+    )
+    assert src.count(target) == 1, "canary anchor drifted"
+    seeded = src.replace(
+        target,
+        "        finally:\n"
+        "            pass  # canary: release dropped\n"
+        "        return np.concatenate(parts, axis=-1), ok\n",
+    )
+    clean = lifecycle.analyze_sources(
+        {rel: parse_source(rel, src)}
+    ).findings
+    assert clean == [], "\n".join(f.render() for f in clean)
+    found = lifecycle.analyze_sources(
+        {rel: parse_source(rel, seeded)}
+    ).findings
+    slines = seeded.splitlines()
+    pass_line = (
+        slines.index("            pass  # canary: release dropped") + 1
+    )
+    leak_line = pass_line + 1  # the return after the gutted finally
+    reserve_line = (
+        next(
+            i
+            for i, ln in enumerate(slines)
+            if "2 * B * n * cw * 4" in ln
+        )
+        + 1
+    )
+    hold_line = reserve_line + 2  # first raisable call inside the try
+    assert {(f.rule, f.line) for f in found} == {
+        ("MTPU603", hold_line),
+        ("MTPU601", leak_line),
+    }, "\n".join(f.render() for f in found)
+
+
+def test_mtpu601_fires_on_seeded_admission_canary():
+    """Canary: a copy of the REAL server/admission.py whose
+    TokenCounter.try_acquire sheds without undoing its probe token
+    leaks one slot per shed — caught at the shed return."""
+    rel = "minio_tpu/server/admission.py"
+    src = _read_tree_source(rel)
+    target = (
+        "        if 0 < limit < len(res):\n"
+        "            try:\n"
+        "                res.pop()\n"
+    )
+    assert src.count(target) == 1, "canary anchor drifted"
+    idx = src.index(target)
+    end = src.index("            return False\n", idx)
+    seeded = (
+        src[:idx]
+        + "        if 0 < limit < len(res):\n"
+        + "            return False  # canary: probe undo dropped\n"
+        + src[end + len("            return False\n"):]
+    )
+    clean = lifecycle.analyze_sources(
+        {rel: parse_source(rel, src)}
+    ).findings
+    assert clean == [], "\n".join(f.render() for f in clean)
+    found = lifecycle.analyze_sources(
+        {rel: parse_source(rel, seeded)}
+    ).findings
+    shed_line = (
+        seeded.splitlines().index(
+            "            return False  # canary: probe undo dropped"
+        )
+        + 1
+    )
+    assert {(f.rule, f.line) for f in found} == {
+        ("MTPU601", shed_line)
+    }, "\n".join(f.render() for f in found)
+
+
+def test_lifecycle_reverse_closure_retriggers_caller_on_helper_edit():
+    """Editing a CALLEE must re-trigger lifecycle on its callers: the
+    helper starts as the release seam for the caller's admission token
+    (caller clean via call-graph credit), then loses the release — the
+    caller now leaks, and the helper's reverse-dependency closure must
+    contain the caller so --changed-only reports it; naive per-file
+    gating would silently skip it."""
+    helper_rel = "minio_tpu/server/lc_helper.py"
+    caller_rel = "minio_tpu/server/lc_caller.py"
+    caller_src = (
+        "from minio_tpu.server.lc_helper import finish\n"
+        "\n"
+        "\n"
+        "def serve(adm, tenant):\n"
+        "    if not adm.try_enter_tenant(tenant):\n"
+        "        return 503\n"
+        "    finish(adm, tenant)\n"
+        "    return 200\n"
+    )
+    helper_v1 = (
+        "def finish(adm, tenant):\n"
+        "    adm.leave_tenant(tenant)\n"
+    )
+    helper_v2 = (
+        "def finish(adm, tenant):\n"
+        "    return (adm, tenant)\n"
+    )
+
+    def run(helper_src):
+        sources = {
+            helper_rel: parse_source(helper_rel, helper_src),
+            caller_rel: parse_source(caller_rel, caller_src),
+        }
+        return lifecycle.analyze_sources(sources)
+
+    before = run(helper_v1)
+    assert before.findings == [], "\n".join(
+        f.render() for f in before.findings
+    )
+
+    after = run(helper_v2)
+    got = {(f.rule, f.path, f.line) for f in after.findings}
+    assert got == {
+        ("MTPU603", caller_rel, 7),
+        ("MTPU601", caller_rel, 8),
+    }, "\n".join(f.render() for f in after.findings)
+
+    # the sound --changed-only trigger set: helper edit pulls in caller
+    closure = after.graph.reverse_file_closure({helper_rel})
+    assert caller_rel in closure
+    restricted = [f for f in after.findings if f.path in closure]
+    assert len(restricted) == 2
+
+
+def test_lifecycle_suppression_and_staleness_audit():
+    """# noqa: MTPU601 silences a real finding; a stale MTPU6xx noqa
+    is itself flagged by the pass's own MTPU106 audit."""
+    lines = _fixture_lines("bad_mtpu601.py")
+    rel = LIFECYCLE_REL_OVERRIDE["bad_mtpu601.py"]
+    idx = next(
+        i for i, ln in enumerate(lines) if "VIOLATION: MTPU601" in ln
+    )
+    suppressed = list(lines)
+    suppressed[idx] = suppressed[idx].split("#")[0].rstrip()
+    suppressed[idx] += "  # noqa: MTPU601"
+    text = "\n".join(suppressed) + "\n"
+    rep = lifecycle.analyze_sources({rel: parse_source(rel, text)})
+    audited = rep.findings + unused_suppressions(
+        rel, text, rep.findings, prefixes=("MTPU6",)
+    )
+    found = filter_suppressed(audited, {rel: suppressed})
+    assert found == [], "\n".join(f.render() for f in found)
+
+    # stale: an MTPU6xx noqa on a code line where nothing fires
+    stale = list(lines)
+    stale_idx = next(
+        i for i, ln in enumerate(stale) if ln.strip() == "return 503"
+    )
+    stale[stale_idx] += "  # noqa: MTPU602"
+    stale_text = "\n".join(stale) + "\n"
+    rep2 = lifecycle.analyze_sources(
+        {rel: parse_source(rel, stale_text)}
+    )
+    audited2 = rep2.findings + unused_suppressions(
+        rel, stale_text, rep2.findings, prefixes=("MTPU6",)
+    )
+    found2 = filter_suppressed(audited2, {rel: stale})
+    assert any(
+        f.rule == "MTPU106" and f.line == stale_idx + 1 for f in found2
+    ), "\n".join(f.render() for f in found2)
+
+
 # -- CLI contract -------------------------------------------------------
 
 
@@ -1122,6 +1441,7 @@ def test_cli_json_is_machine_readable_and_stable():
         "contracts",
         "locks",
         "deviceflow",
+        "lifecycle",
     )
     r1 = _run_cli(*args)
     r2 = _run_cli(*args)
@@ -1138,12 +1458,12 @@ def test_cli_json_is_machine_readable_and_stable():
     assert {d["rule"] for d in data} == {"MTPU101", "MTPU104"}
     assert set(data[0]) == {"rule", "path", "line", "message"}
     assert set(d1["passes"]) == {"lint", "abi"}
-    assert d1["callgraph"] is None  # deviceflow skipped
+    assert d1["callgraph"] is None  # deviceflow + lifecycle skipped
 
 
 def test_cli_json_reports_timings_and_callgraph_stats():
     """--json carries per-pass wall seconds and the call-graph block
-    when the deviceflow pass runs."""
+    when the interprocedural passes run."""
     r = _run_cli(
         "--json",
         "--paths",
@@ -1156,7 +1476,7 @@ def test_cli_json_reports_timings_and_callgraph_stats():
     assert r.returncode == 0, r.stdout + r.stderr
     data = json.loads(r.stdout)
     assert data["findings"] == []
-    assert set(data["passes"]) == {"lint", "deviceflow"}
+    assert set(data["passes"]) == {"lint", "deviceflow", "lifecycle"}
     for secs in data["passes"].values():
         assert isinstance(secs, float) and secs >= 0.0
     cg = data["callgraph"]
@@ -1169,10 +1489,15 @@ def test_cli_list_rules():
     assert r.returncode == 0
     for rule in RULES:
         assert rule in r.stdout
+    # the lifecycle rules are part of the published catalog
+    for i in range(1, 7):
+        assert f"MTPU60{i}" in r.stdout
 
 
 def test_cli_skip_covers_the_abi_pass():
-    r = _run_cli("--skip", "abi", "contracts", "locks", "deviceflow")
+    r = _run_cli(
+        "--skip", "abi", "contracts", "locks", "deviceflow", "lifecycle"
+    )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "[lint]" in r.stderr
 
@@ -1185,14 +1510,15 @@ def test_cli_changed_only_exits_zero():
 
 @pytest.mark.slow
 def test_cli_full_run_is_clean():
-    """All five passes through the real CLI (what CI would run), and
+    """All six passes through the real CLI (what CI would run), and
     the full run stays inside the 30s analyzer budget."""
     t0 = time.monotonic()
     r = _run_cli()
     wall = time.monotonic() - t0
     assert r.returncode == 0, r.stdout + r.stderr
     assert (
-        "0 finding(s) [lint, abi, contracts, locks, deviceflow]"
+        "0 finding(s) "
+        "[lint, abi, contracts, locks, deviceflow, lifecycle]"
         in r.stderr
     )
     assert wall < 30.0, f"full analyzer run took {wall:.1f}s (budget 30s)"

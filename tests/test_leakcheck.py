@@ -36,6 +36,50 @@ def test_server_lifecycle_leaks_nothing(leakcheck, tmp_path):
         srv.shutdown()
 
 
+def test_resource_balances_converge_to_zero(leakcheck, tmp_path):
+    """Runtime cross-check of the MTPU6xx static proof: after PUT/GET
+    traffic plus a forced admission shed, every statically-proved
+    balance is empirically zero — admission tokens (tenant and
+    select), the plane inflight gauge, and the codec's device-byte
+    staging account."""
+    from minio_tpu.cache.allocator import device_budget
+    from minio_tpu.server.admission import TokenCounter
+
+    disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+    ol = ErasureObjects(disks, block_size=4096, min_part_size=1)
+    srv = S3Server(ol, address="127.0.0.1:0").start()
+    try:
+        c = S3Client(srv.endpoint)
+        assert c.make_bucket("balb").status == 200
+        for i in range(3):
+            assert c.put_object(
+                "balb", f"o{i}", b"y" * 9000
+            ).status == 200
+            assert c.get_object("balb", f"o{i}").status == 200
+        # forced shed: the probe token the refused path takes must be
+        # undone (the MTPU601 admission canary drops exactly that undo)
+        ctr = TokenCounter()
+        assert ctr.try_acquire(1) is True
+        assert ctr.try_acquire(1) is False
+        ctr.release()
+        assert ctr.value() == 0
+        assert len(ctr._res) == 0
+        # the final release races the response write (route()'s
+        # finally runs after the client sees the bytes): poll briefly
+        adm = srv.admission
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and (
+            adm.tenant_inflight() or srv.plane_stats.inflight
+        ):
+            time.sleep(0.01)
+        assert adm.tenant_inflight() == {}
+        assert adm.select_inflight() == 0
+        assert srv.plane_stats.inflight == 0
+    finally:
+        srv.shutdown()
+    assert device_budget().usage("codec_staging") == 0
+
+
 def test_detector_catches_a_deliberate_leak():
     """The fixture machinery itself must trip on a leaked thread."""
     before = set(threading.enumerate())
